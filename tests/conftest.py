@@ -1,0 +1,36 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX's backend
+initializes.
+
+SURVEY.md §4: the TPU-world answer to "test multi-node without a cluster" is
+``--xla_force_host_platform_device_count``.  All tests run against 8 virtual
+CPU devices so every mesh/sharding path is exercised without TPU hardware.
+The image's sitecustomize may have imported jax already (registering a TPU
+plugin and pinning JAX_PLATFORMS); ``simulate_cpu_devices`` overrides both the
+env and the live jax config.
+"""
+
+import jax
+import pytest
+
+from tpuframe.core.runtime import simulate_cpu_devices
+
+simulate_cpu_devices(8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8():
+    from tpuframe.core import MeshSpec
+
+    return MeshSpec(data=2, fsdp=2, model=2).build()
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)

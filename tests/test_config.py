@@ -1,0 +1,104 @@
+import os
+
+import pytest
+
+from tpuframe.core import AUTO, Config, load_config
+
+
+def test_attribute_and_item_access():
+    cfg = Config({"train": {"batch_size": 128, "opt": {"lr": 1e-3}}})
+    assert cfg.train.batch_size == 128
+    assert cfg["train"]["opt"]["lr"] == 1e-3
+    cfg.train.batch_size = 64
+    assert cfg["train"]["batch_size"] == 64
+    with pytest.raises(AttributeError):
+        _ = cfg.nope
+
+
+def test_nested_assignment_wraps():
+    cfg = Config()
+    cfg.data = {"cache": "/tmp/x", "sub": {"a": 1}}
+    assert isinstance(cfg.data, Config)
+    assert cfg.data.sub.a == 1
+
+
+def test_deep_merge_later_wins():
+    base = Config({"a": {"x": 1, "y": 2}, "b": 3})
+    out = base.merged({"a": {"y": 20, "z": 30}})
+    assert out.a.x == 1 and out.a.y == 20 and out.a.z == 30 and out.b == 3
+    # original untouched
+    assert base.a.y == 2
+
+
+def test_dotted_paths():
+    cfg = Config()
+    cfg.set_path("zero.stage", 2)
+    assert cfg.zero.stage == 2
+    assert cfg.get_path("zero.stage") == 2
+    assert cfg.get_path("zero.missing", "d") == "d"
+    assert cfg.flat() == {"zero.stage": 2}
+
+
+def test_yaml_round_trip(tmp_path):
+    cfg = Config({"catalog": "main", "num_nodes": 4, "train": {"bf16": True}})
+    path = tmp_path / "cfg.yaml"
+    cfg.to_yaml(path)
+    back = Config.from_yaml(path)
+    assert back.to_dict() == cfg.to_dict()
+
+
+def test_env_overlay(monkeypatch):
+    monkeypatch.setenv("TPUFRAME_TRAIN__BATCH_SIZE", "256")
+    monkeypatch.setenv("TPUFRAME_TRAIN__BF16", "true")
+    monkeypatch.setenv("OTHER_VAR", "1")
+    cfg = Config({"train": {"batch_size": 1}}).overlay_env()
+    assert cfg.train.batch_size == 256
+    assert cfg.train.bf16 is True
+    assert "other_var" not in cfg
+
+
+def test_auto_resolution():
+    cfg = Config(
+        {
+            "train_batch_size": AUTO,
+            "zero": {"reduce_bucket_size": AUTO},
+            "lr": 1e-3,
+        }
+    )
+    assert set(cfg.auto_paths()) == {"train_batch_size", "zero.reduce_bucket_size"}
+    out = cfg.resolve_auto(
+        {
+            "train_batch_size": lambda c: 512,
+            "zero.*": lambda c: 5e8,
+        }
+    )
+    assert out.train_batch_size == 512
+    assert out.zero.reduce_bucket_size == 5e8
+    # strict mode flags leftovers
+    with pytest.raises(ValueError):
+        cfg.resolve_auto({"train_batch_size": lambda c: 1})
+
+
+def test_load_config_layering(tmp_path, monkeypatch):
+    path = tmp_path / "local.yaml"
+    path.write_text("catalog: main\nnum_nodes: 2\n")
+    monkeypatch.setenv("TPUFRAME_NUM_NODES", "8")
+    cfg = load_config(path, overrides={"num_nodes": 4, "extra": 1})
+    # env beats overrides beats file
+    assert cfg.catalog == "main" and cfg.num_nodes == 8 and cfg.extra == 1
+
+
+def test_auto_inside_lists_detected():
+    cfg = Config({"stages": [{"bucket": AUTO}, {"bucket": 1}]})
+    assert cfg.auto_paths() == ["stages.0.bucket"]
+    out = cfg.resolve_auto({"stages.*.bucket": lambda c: 5e8})
+    assert out.stages[0].bucket == 5e8
+    with pytest.raises(ValueError):
+        cfg.resolve_auto({})
+
+
+def test_env_overlay_conflict_raises(monkeypatch):
+    monkeypatch.setenv("TPUFRAME_TRAIN", "fast")
+    monkeypatch.setenv("TPUFRAME_TRAIN__LR", "0.1")
+    with pytest.raises(ValueError):
+        Config().overlay_env()

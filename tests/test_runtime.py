@@ -1,0 +1,81 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuframe.core import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshSpec,
+    current_runtime,
+    initialize,
+    is_main_process,
+)
+from tpuframe.core import runtime as rt_mod
+
+
+def test_meshspec_resolve_wildcard():
+    spec = MeshSpec(data=-1, model=2)
+    sizes = spec.resolve(8)
+    assert sizes[DATA_AXIS] == 4 and sizes[MODEL_AXIS] == 2
+
+
+def test_meshspec_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=2, model=2).resolve(8)  # fixed product != devices
+    with pytest.raises(ValueError):
+        MeshSpec.from_config({"bogus_axis": 2})
+
+
+def test_mesh_build_all_axes_present():
+    mesh = MeshSpec(data=2, fsdp=2, model=2).build()
+    assert set(mesh.axis_names) == {"pipe", "data", "fsdp", "seq", "expert", "model"}
+    assert mesh.devices.size == 8
+
+
+def test_sharded_matmul_on_mesh(mesh8):
+    x = jnp.ones((16, 32))
+    w = jnp.ones((32, 8))
+    xs = jax.device_put(x, NamedSharding(mesh8, P(("data", "fsdp"), None)))
+    ws = jax.device_put(w, NamedSharding(mesh8, P(None, "model")))
+    out = jax.jit(lambda a, b: a @ b)(xs, ws)
+    np.testing.assert_allclose(np.asarray(out), np.full((16, 8), 32.0))
+
+
+def test_initialize_and_runtime_helpers():
+    rt_mod.reset_runtime()
+    rt = initialize(MeshSpec(data=4, model=2))
+    assert rt.device_count == 8
+    assert rt.is_main and is_main_process()
+    assert current_runtime() is rt
+    assert rt.sharding("data").spec == P("data")
+    batch = jax.device_put(jnp.zeros((8, 4)), rt.data_sharding())
+    assert batch.sharding.spec == P(("data", "fsdp"))
+    rt_mod.reset_runtime()
+
+
+def test_runtime_from_mapping():
+    rt_mod.reset_runtime()
+    rt = initialize({"data": 2, "fsdp": 2, "model": 2})
+    assert rt.spec.fsdp == 2
+    rt_mod.reset_runtime()
+
+
+def test_meshspec_rejects_zero_and_negative():
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=0).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-2).resolve(8)
+
+
+def test_initialize_half_specified_multihost_raises(monkeypatch):
+    rt_mod.reset_runtime()
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    with pytest.raises(ValueError):
+        initialize()
+    rt_mod.reset_runtime()

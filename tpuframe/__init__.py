@@ -1,0 +1,42 @@
+"""tpuframe — a TPU-native distributed training framework on JAX/XLA/Pallas.
+
+Provides, TPU-first, the capability set that the reference examples repo
+(`alexxx-db/dbx-distributed-pytorch-examples`) consumes from its dependency
+stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
+
+- ``tpuframe.core``     — config tree, runtime init, device meshes, control plane
+- ``tpuframe.models``   — ResNet family + CNNs (flax), transfer-learning wrappers
+- ``tpuframe.data``     — transforms, datasets, sharded loaders, streaming shards
+- ``tpuframe.parallel`` — DP / ZeRO-1/2/3 / TP sharding rules over a Mesh
+- ``tpuframe.train``    — jitted train steps, high-level Trainer, Accelerator API
+- ``tpuframe.launch``   — Distributor ``.run()`` + Ray-style TPUTrainer/Result
+- ``tpuframe.track``    — MLflow-compatible experiment tracking
+- ``tpuframe.ckpt``     — sharded checkpoint save/restore (orbax-backed)
+- ``tpuframe.ops``      — Pallas TPU kernels for hot ops
+"""
+
+__version__ = "0.1.0"
+
+_SUBMODULES = (
+    "core",
+    "data",
+    "models",
+    "parallel",
+    "train",
+    "launch",
+    "track",
+    "ckpt",
+    "ops",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"tpuframe.{name}")
+    raise AttributeError(f"module 'tpuframe' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

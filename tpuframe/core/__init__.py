@@ -1,0 +1,37 @@
+"""Core runtime: configuration, process/runtime init, device meshes, control plane."""
+
+from tpuframe.core.config import AUTO, Config, load_config
+from tpuframe.core.runtime import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    MeshSpec,
+    Runtime,
+    current_runtime,
+    initialize,
+    is_main_process,
+    process_count,
+    process_index,
+)
+
+__all__ = [
+    "AUTO",
+    "Config",
+    "load_config",
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "MODEL_AXIS",
+    "PIPELINE_AXIS",
+    "SEQUENCE_AXIS",
+    "EXPERT_AXIS",
+    "MeshSpec",
+    "Runtime",
+    "current_runtime",
+    "initialize",
+    "is_main_process",
+    "process_count",
+    "process_index",
+]
