@@ -1,0 +1,153 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.models import (
+    MnistNet,
+    ResNet18,
+    ResNet50,
+    TransferClassifier,
+    backbone_frozen_labels,
+)
+
+
+def n_params(tree):
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_resnet18_cifar_shapes_and_param_count(rng):
+    model = ResNet18(num_classes=10, stem="cifar")
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(rng, x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 10)
+    # reference from-scratch ResNet18 (setup/resnet18.py) ~11.2M params
+    assert 10.5e6 < n_params(variables["params"]) < 11.5e6
+
+
+def test_resnet50_imagenet_shapes_and_param_count(rng):
+    model = ResNet50(num_classes=1000)
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(rng, x)
+    out = model.apply(variables, x)
+    assert out.shape == (1, 1000)
+    # torchvision resnet50 has 25.56M params
+    assert 25.0e6 < n_params(variables["params"]) < 26.1e6
+
+
+def test_resnet_train_mode_updates_batch_stats(rng):
+    model = ResNet18(num_classes=10, stem="cifar")
+    x = jax.random.normal(rng, (4, 32, 32, 3))
+    variables = model.init(rng, x)
+    out, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert out.shape == (4, 10)
+    before = variables["batch_stats"]["bn1"]["mean"]
+    after = mutated["batch_stats"]["bn1"]["mean"]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_resnet_bf16_compute_f32_out(rng):
+    model = ResNet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(rng, x)
+    out = model.apply(variables, x)
+    assert out.dtype == jnp.float32
+    # params stay f32
+    assert variables["params"]["conv1"]["kernel"].dtype == jnp.float32
+
+
+def test_mnist_net_log_probs(rng):
+    model = MnistNet()
+    x = jnp.zeros((3, 28, 28, 1))
+    variables = model.init(rng, x)
+    out = model.apply(variables, x)
+    assert out.shape == (3, 10)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+    # dropout active in train mode needs an rng
+    out2 = model.apply(
+        variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    assert out2.shape == (3, 10)
+
+
+def test_transfer_classifier_and_freeze_labels(rng):
+    backbone = ResNet18(num_classes=0, stem="cifar")
+    model = TransferClassifier(backbone=backbone, num_classes=7)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(rng, x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 7)
+    assert set(variables["params"].keys()) == {"backbone", "head"}
+    labels = backbone_frozen_labels(variables["params"])
+    flat = jax.tree_util.tree_leaves(labels["backbone"])
+    assert all(l == "frozen" for l in flat)
+    assert all(
+        l == "trainable" for l in jax.tree_util.tree_leaves(labels["head"])
+    )
+
+    # frozen leaves actually receive zero updates through optax
+    import optax
+
+    tx = optax.multi_transform(
+        {"trainable": optax.sgd(0.1), "frozen": optax.set_to_zero()},
+        backbone_frozen_labels(variables["params"]),
+    )
+    state = tx.init(variables["params"])
+    grads = jax.tree_util.tree_map(jnp.ones_like, variables["params"])
+    updates, _ = tx.update(grads, state, variables["params"])
+    assert float(jnp.abs(updates["backbone"]["conv1"]["kernel"]).max()) == 0.0
+    assert float(jnp.abs(updates["head"]["kernel"]).max()) > 0.0
+
+
+def test_torch_resnet_import_round_trip(rng):
+    """Build a fake torchvision-format state_dict and import it."""
+    from tpuframe.models.interop import import_torch_resnet
+
+    model = ResNet18(num_classes=10)
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(rng, x)
+
+    # synthesize a torch-style state_dict matching resnet18 shapes
+    sd = {}
+
+    def conv_entry(name, kernel):
+        h, w, i, o = kernel.shape
+        sd[name + ".weight"] = np.random.randn(o, i, h, w).astype(np.float32)
+
+    def bn_entry(name, size):
+        sd[name + ".weight"] = np.random.randn(size).astype(np.float32)
+        sd[name + ".bias"] = np.random.randn(size).astype(np.float32)
+        sd[name + ".running_mean"] = np.zeros(size, np.float32)
+        sd[name + ".running_var"] = np.ones(size, np.float32)
+        sd[name + ".num_batches_tracked"] = np.array(0)
+
+    conv_entry("conv1", variables["params"]["conv1"]["kernel"])
+    bn_entry("bn1", 64)
+    for stage, (filters, blocks) in enumerate([(64, 2), (128, 2), (256, 2), (512, 2)]):
+        for b in range(blocks):
+            pt = f"layer{stage + 1}.{b}"
+            fx = f"layer{stage + 1}_{b}"
+            p = variables["params"][fx]
+            conv_entry(pt + ".conv1", p["conv1"]["kernel"])
+            bn_entry(pt + ".bn1", filters)
+            conv_entry(pt + ".conv2", p["conv2"]["kernel"])
+            bn_entry(pt + ".bn2", filters)
+            if "downsample_conv" in p:
+                conv_entry(pt + ".downsample.0", p["downsample_conv"]["kernel"])
+                bn_entry(pt + ".downsample.1", filters)
+    sd["fc.weight"] = np.random.randn(10, 512).astype(np.float32)
+    sd["fc.bias"] = np.random.randn(10).astype(np.float32)
+
+    imported = import_torch_resnet(sd)
+
+    # identical tree structure and shapes -> apply must work
+    ref_shapes = jax.tree_util.tree_map(jnp.shape, variables["params"])
+    imp_shapes = jax.tree_util.tree_map(np.shape, imported["params"])
+    assert ref_shapes == imp_shapes
+    out = model.apply(
+        {"params": imported["params"], "batch_stats": imported["batch_stats"]}, x
+    )
+    assert out.shape == (1, 10)

@@ -1,0 +1,53 @@
+"""Data pipeline: transforms, datasets, sharded loaders, streaming shards.
+
+TPU-native replacement for the reference's L1 layer
+(`/root/reference/utils/hf_dataset_utilities.py`, MDS streaming path in
+`/root/reference/01_torch_distributor/03a_tiny_imagenet_torch_distributor_resnet_mds.py`):
+host-side numpy transforms feeding double-buffered device prefetch into HBM,
+plus an MDS-equivalent compressed shard format with remote->local caching.
+"""
+
+from tpuframe.data.datasets import (
+    ArrayDataset,
+    SyntheticImageDataset,
+    Timer,
+    hf_get_num_classes,
+    hfds_download,
+    make_image_dataset,
+)
+from tpuframe.data.loader import DataLoader, DevicePrefetcher
+from tpuframe.data.streaming import ShardWriter, StreamingDataset, clean_stale_cache
+from tpuframe.data.transforms import (
+    CenterCrop,
+    Compose,
+    GrayscaleToRGB,
+    Normalize,
+    RandomCrop,
+    RandomHorizontalFlip,
+    Resize,
+    ToFloat,
+    default_image_transforms,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "SyntheticImageDataset",
+    "Timer",
+    "hf_get_num_classes",
+    "hfds_download",
+    "make_image_dataset",
+    "DataLoader",
+    "DevicePrefetcher",
+    "ShardWriter",
+    "StreamingDataset",
+    "clean_stale_cache",
+    "Compose",
+    "Resize",
+    "RandomCrop",
+    "CenterCrop",
+    "RandomHorizontalFlip",
+    "GrayscaleToRGB",
+    "Normalize",
+    "ToFloat",
+    "default_image_transforms",
+]

@@ -1,0 +1,169 @@
+"""Map-style datasets and HF-dataset ingest.
+
+Capability parity with `/root/reference/utils/hf_dataset_utilities.py`:
+
+- :func:`hfds_download` ≈ ``hfds_download_volume`` (`:8-19`) — pull an HF
+  dataset into a cache dir (gated: this container has no egress, so it only
+  works against an already-populated cache or local dataset script).
+- :func:`hf_get_num_classes` ≈ (`:21-29`).
+- :func:`make_image_dataset` ≈ ``create_torch_image_dataset`` (`:35-56`) —
+  in-memory images+labels with per-item transform.
+- :class:`Timer` ≈ (`:83-89`).
+
+Plus :class:`SyntheticImageDataset` — deterministic fake data for tests and
+benchmarks (the reference has no offline story; a TPU framework needs one).
+"""
+
+from __future__ import annotations
+
+import timeit
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class ArrayDataset:
+    """In-memory (images, labels) with optional per-item transform.
+
+    ``rng_seed`` makes augmentation deterministic per (seed, index, epoch);
+    call :meth:`set_epoch` to reshuffle augmentation randomness each epoch.
+    """
+
+    def __init__(
+        self,
+        images: Sequence[Any],
+        labels: Sequence[int],
+        transform: Callable | None = None,
+        rng_seed: int = 0,
+    ):
+        if len(images) != len(labels):
+            raise ValueError(f"{len(images)} images vs {len(labels)} labels")
+        self.images = images
+        self.labels = np.asarray(labels, np.int32)
+        self.transform = transform
+        self.rng_seed = rng_seed
+        self.epoch = 0
+        self.num_classes = len(set(int(l) for l in labels))
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def __getitem__(self, idx: int):
+        image = self.images[idx]
+        if self.transform is not None:
+            rng = np.random.default_rng(
+                (self.rng_seed * 1_000_003 + self.epoch) * 1_000_003 + idx
+            )
+            image = self.transform(image, rng)
+        return np.asarray(image), int(self.labels[idx])
+
+
+def make_image_dataset(
+    data: Any,
+    image_key: str = "img",
+    label_key: str = "label",
+    transform: Callable | None = None,
+) -> ArrayDataset:
+    """Build an ArrayDataset from a dict-like split (HF dataset split or dict).
+
+    Mirrors ``create_torch_image_dataset`` (`utils/hf_dataset_utilities.py:35-56`)
+    without the class-factory indirection: you get a dataset, not a class.
+    """
+    return ArrayDataset(data[image_key], data[label_key], transform=transform)
+
+
+def hfds_download(
+    dataset_path: str,
+    cache_dir: str,
+    trust_remote_code: bool = False,
+    **kwargs: Any,
+):
+    """Download/load an HF dataset dict into ``cache_dir``.
+
+    ≈ ``hfds_download_volume`` (`utils/hf_dataset_utilities.py:8-19`).  In a
+    zero-egress environment this succeeds only for datasets already present in
+    the cache; the error message says so instead of timing out.
+    """
+    try:
+        from datasets import load_dataset
+    except ImportError as e:
+        raise ImportError("the 'datasets' package is required for HF ingest") from e
+    try:
+        return load_dataset(
+            path=dataset_path,
+            cache_dir=cache_dir,
+            trust_remote_code=trust_remote_code,
+            **kwargs,
+        )
+    except Exception as e:  # pragma: no cover - depends on network
+        raise RuntimeError(
+            f"could not load HF dataset {dataset_path!r} from cache {cache_dir!r}; "
+            "if this host has no network egress, pre-populate the cache or use "
+            "tpuframe.data.SyntheticImageDataset / StreamingDataset"
+        ) from e
+
+
+def hf_get_num_classes(dataset: Any, split_key: str, label_key: str = "label") -> int:
+    """≈ reference ``hf_get_num_classes`` (`utils/hf_dataset_utilities.py:21-29`)."""
+    return len(set(dataset[split_key][label_key]))
+
+
+class SyntheticImageDataset:
+    """Deterministic synthetic image classification data (for tests/bench).
+
+    Images are generated on-the-fly from the index (no memory footprint);
+    labels are derived from the index so accuracy above chance is learnable
+    (class-conditional mean shift).
+    """
+
+    def __init__(
+        self,
+        n: int = 1024,
+        image_size: int = 32,
+        channels: int = 3,
+        num_classes: int = 10,
+        seed: int = 0,
+        transform: Callable | None = None,
+    ):
+        self.n = n
+        self.image_size = image_size
+        self.channels = channels
+        self.num_classes = num_classes
+        self.seed = seed
+        self.transform = transform
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, idx: int):
+        label = idx % self.num_classes
+        rng = np.random.default_rng(self.seed * 1_000_003 + idx)
+        img = rng.integers(
+            0, 256, (self.image_size, self.image_size, self.channels), dtype=np.uint8
+        )
+        # class-conditional brightness shift makes the task learnable
+        img = np.clip(img.astype(np.int32) + label * 8, 0, 255).astype(np.uint8)
+        if self.transform is not None:
+            t_rng = np.random.default_rng(
+                (self.seed * 1_000_003 + self.epoch) * 1_000_003 + idx
+            )
+            img = self.transform(img, t_rng)
+        return np.asarray(img), label
+
+
+class Timer:
+    """Wall-clock timer (`utils/hf_dataset_utilities.py:83-89`)."""
+
+    def __init__(self):
+        self.start = timeit.default_timer()
+
+    def stop(self) -> float:
+        self.end = timeit.default_timer()
+        return self.end - self.start

@@ -1,0 +1,230 @@
+"""Sharded batch loader + double-buffered device prefetch.
+
+TPU-native equivalent of the reference's ``DistributedSampler + DataLoader``
+stack (`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:285-286`,
+`prepare_data_loader` in `/root/reference/05_ray/01_fashion_mnist_pytorch_ray.ipynb:cell-6`):
+
+- :class:`DataLoader` shards the index space across *processes* (hosts), not
+  across chips — each host materializes only its slice ("dataset handles, not
+  dataset bytes, cross the process boundary", SURVEY.md §3.2), with
+  ``set_epoch`` reshuffle semantics (`sampler.set_epoch(epoch)` parity).
+- :class:`DevicePrefetcher` turns host batches into *global* jax Arrays laid
+  out over the mesh's data axes and keeps ``depth`` batches in flight so
+  host->HBM copies overlap compute (the role cuda streams/pin_memory play in
+  the torch stack).
+
+Batches are NHWC float32 (or uint8, converted on device); static shapes only —
+the final ragged batch is either dropped (train) or padded with a validity
+mask (eval) so XLA never recompiles.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+from tpuframe.core import runtime as rt
+
+
+class DataLoader:
+    """Iterates (images, labels[, valid_mask]) numpy batches of this process's shard.
+
+    Args:
+      dataset: map-style dataset (``__len__``/``__getitem__`` -> (img, label)).
+      batch_size: **global** batch size; each process yields
+        ``batch_size // process_count`` samples per step.
+      shuffle: reshuffle per epoch from (seed, epoch) — equal permutations on
+        every process, like DistributedSampler.
+      drop_last: drop the trailing ragged batch (train default).  When False,
+        the last batch is padded to full size and a boolean ``valid`` mask is
+        yielded as third element (static shapes for jit-eval).
+      num_workers: thread pool size for item fetch/transform (0 = inline).
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        num_workers: int = 0,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        self.dataset = dataset
+        self.global_batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.num_workers = num_workers
+        self._epoch = 0
+        self.process_index = (
+            rt.process_index() if process_index is None else process_index
+        )
+        self.process_count = (
+            rt.process_count() if process_count is None else process_count
+        )
+        if self.global_batch_size % self.process_count:
+            raise ValueError(
+                f"global batch size {batch_size} not divisible by "
+                f"{self.process_count} processes"
+            )
+        self.local_batch_size = self.global_batch_size // self.process_count
+
+    def set_epoch(self, epoch: int) -> None:
+        """DistributedSampler.set_epoch parity — changes the shuffle order."""
+        self._epoch = int(epoch)
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def _per_process_count(self) -> int:
+        n = len(self.dataset)
+        if not self.drop_last and n % self.process_count:
+            return n // self.process_count + 1
+        return n // self.process_count
+
+    def _indices(self) -> tuple[np.ndarray, np.ndarray]:
+        """This process's (indices, genuine) — genuine=False marks wrap-pad
+        duplicates added only to equalize per-process counts."""
+        n = len(self.dataset)
+        order = (
+            np.random.default_rng(self.seed * 1_000_003 + self._epoch).permutation(n)
+            if self.shuffle
+            else np.arange(n)
+        )
+        genuine = np.ones(n, bool)
+        # Equal per-process share, DistributedSampler-style wrap-around pad —
+        # but padded duplicates are flagged so eval never double-counts them.
+        per_proc = self._per_process_count()
+        total = per_proc * self.process_count
+        if total > n:
+            order = np.concatenate([order, order[: total - n]])
+            genuine = np.concatenate([genuine, np.zeros(total - n, bool)])
+        else:
+            order, genuine = order[:total], genuine[:total]
+        sl = slice(self.process_index, None, self.process_count)
+        return order[sl], genuine[sl]
+
+    def __len__(self) -> int:
+        per_proc = self._per_process_count()
+        if self.drop_last:
+            return per_proc // self.local_batch_size
+        return -(-per_proc // self.local_batch_size)
+
+    def __iter__(self) -> Iterator[tuple]:
+        indices, genuine = self._indices()
+        nb_full = len(indices) // self.local_batch_size
+        tail = len(indices) % self.local_batch_size
+
+        pool = ThreadPoolExecutor(self.num_workers) if self.num_workers else None
+        fetch = (lambda idxs: list(pool.map(self.dataset.__getitem__, idxs))) if pool \
+            else (lambda idxs: [self.dataset[i] for i in idxs])
+        try:
+            for b in range(nb_full):
+                sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)
+                items = fetch(indices[sl])
+                images = np.stack([im for im, _ in items])
+                labels = np.asarray([lb for _, lb in items], np.int32)
+                if self.drop_last:
+                    yield images, labels
+                else:
+                    yield images, labels, genuine[sl].copy()
+            if tail and not self.drop_last:
+                sl = slice(nb_full * self.local_batch_size, None)
+                items = fetch(indices[sl])
+                pad = self.local_batch_size - len(items)
+                images = np.stack([im for im, _ in items] + [items[-1][0]] * pad)
+                labels = np.asarray(
+                    [lb for _, lb in items] + [items[-1][1]] * pad, np.int32
+                )
+                valid = np.concatenate([genuine[sl], np.zeros(pad, bool)])
+                yield images, labels, valid
+        finally:
+            if pool:
+                pool.shutdown(wait=False)
+
+
+class DevicePrefetcher:
+    """Wrap a host-batch iterable into global device Arrays, ``depth`` in flight.
+
+    Each host batch (this process's shard) becomes one global jax.Array sharded
+    over the mesh's (data, fsdp) axes via
+    ``jax.make_array_from_process_local_data`` — the multi-host-safe way to
+    assemble a global batch.  A background thread keeps the pipeline full so
+    H2D copies overlap the train step (double-buffering; depth=2 default).
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Any, depth: int = 2, sharding=None):
+        self.it = it
+        if sharding is None:
+            sharding = rt.current_runtime().data_sharding()
+        self.sharding = sharding
+        self.depth = max(1, depth)
+
+    def _put(self, batch: tuple) -> tuple:
+        return tuple(
+            jax.make_array_from_process_local_data(
+                self.sharding_for(np.asarray(x)), np.asarray(x)
+            )
+            for x in batch
+        )
+
+    def sharding_for(self, x: np.ndarray):
+        # batch-dim sharding only; trailing dims replicated
+        spec = list(self.sharding.spec) + [None] * (x.ndim - len(self.sharding.spec))
+        return jax.sharding.NamedSharding(
+            self.sharding.mesh, jax.sharding.PartitionSpec(*spec)
+        )
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        err: list[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for batch in self.it:
+                    if not put(self._put(batch)):
+                        return  # consumer went away
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                put(self._DONE)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # Early consumer exit (break / GeneratorExit): release the worker
+            # so it doesn't pin `depth` device batches forever.
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

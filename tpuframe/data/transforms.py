@@ -1,0 +1,182 @@
+"""Host-side image transforms (numpy, HWC), explicit-rng functional style.
+
+Semantics parity with the reference's torchvision pipeline
+(`/root/reference/utils/hf_dataset_utilities.py:58-81`):
+resize -> random horizontal flip -> to float tensor -> grayscale->RGB ->
+ImageNet-stats normalize.  Differences by design:
+
+- arrays stay HWC uint8/float32 numpy (NHWC batches feed XLA directly; no CHW
+  detour) and transforms take an explicit ``np.random.Generator`` instead of
+  mutating global RNG state — reproducible across workers by construction.
+- heavy per-pixel math (normalize, flip) can also be fused on-device; these
+  host versions exist for the host-CPU decode/augment stage of the input
+  pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+#: ImageNet statistics used throughout the reference
+#: (`utils/hf_dataset_utilities.py:74-77`).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+Transform = Callable[[np.ndarray, np.random.Generator], np.ndarray]
+
+
+def _to_array(img) -> np.ndarray:
+    """Accept PIL images or arrays; return HWC (or HW) numpy."""
+    arr = np.asarray(img)
+    return arr
+
+
+class Compose:
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def __call__(self, img, rng: np.random.Generator | None = None) -> np.ndarray:
+        if rng is None:
+            rng = np.random.default_rng()
+        out = _to_array(img)
+        for t in self.transforms:
+            out = t(out, rng)
+        return out
+
+    def __repr__(self):
+        return f"Compose({self.transforms!r})"
+
+
+class Resize:
+    """Resize to (size, size) — PIL bilinear when available, else numpy nearest."""
+
+    def __init__(self, size: int):
+        self.size = int(size)
+
+    def __call__(self, img: np.ndarray, rng) -> np.ndarray:
+        h, w = img.shape[:2]
+        if (h, w) == (self.size, self.size):
+            return img
+        try:
+            from PIL import Image
+
+            if img.dtype == np.uint8:
+                out = np.asarray(
+                    Image.fromarray(img).resize((self.size, self.size), Image.BILINEAR)
+                )
+            else:
+                # float images: PIL only supports single-channel 'F' mode, so
+                # resize channel-by-channel without any dtype truncation.
+                chans = img[:, :, None] if img.ndim == 2 else img
+                out = np.stack(
+                    [
+                        np.asarray(
+                            Image.fromarray(chans[:, :, c].astype(np.float32), "F")
+                            .resize((self.size, self.size), Image.BILINEAR)
+                        )
+                        for c in range(chans.shape[-1])
+                    ],
+                    axis=-1,
+                ).astype(img.dtype)
+                if img.ndim == 2:
+                    out = out[:, :, 0]
+            return out
+        except ImportError:
+            ys = (np.arange(self.size) * h / self.size).astype(np.int64)
+            xs = (np.arange(self.size) * w / self.size).astype(np.int64)
+            return img[ys][:, xs]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        if rng.random() < self.p:
+            return img[:, ::-1]
+        return img
+
+
+class RandomCrop:
+    """Pad-then-crop (torchvision RandomCrop(size, padding) semantics)."""
+
+    def __init__(self, size: int, padding: int = 0):
+        self.size = int(size)
+        self.padding = int(padding)
+
+    def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)]
+            if img.ndim == 3:
+                pad.append((0, 0))
+            img = np.pad(img, pad, mode="constant")
+        h, w = img.shape[:2]
+        top = int(rng.integers(0, h - self.size + 1))
+        left = int(rng.integers(0, w - self.size + 1))
+        return img[top : top + self.size, left : left + self.size]
+
+
+class CenterCrop:
+    def __init__(self, size: int):
+        self.size = int(size)
+
+    def __call__(self, img: np.ndarray, rng) -> np.ndarray:
+        h, w = img.shape[:2]
+        top = max(0, (h - self.size) // 2)
+        left = max(0, (w - self.size) // 2)
+        return img[top : top + self.size, left : left + self.size]
+
+
+class ToFloat:
+    """uint8 [0,255] -> float32 [0,1]; ensures a channel dim exists."""
+
+    def __call__(self, img: np.ndarray, rng) -> np.ndarray:
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.dtype == np.uint8:
+            return img.astype(np.float32) / 255.0
+        return img.astype(np.float32)
+
+
+class GrayscaleToRGB:
+    """1-channel -> 3-channel by repeat (`utils/hf_dataset_utilities.py:71`)."""
+
+    def __call__(self, img: np.ndarray, rng) -> np.ndarray:
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.shape[-1] == 1:
+            return np.repeat(img, 3, axis=-1)
+        return img
+
+
+class Normalize:
+    def __init__(
+        self,
+        mean: Sequence[float] = IMAGENET_MEAN,
+        std: Sequence[float] = IMAGENET_STD,
+    ):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, img: np.ndarray, rng) -> np.ndarray:
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+def default_image_transforms(
+    image_size: int,
+    normalize_transform: bool = True,
+    convert_rgb: bool = True,
+    random_flip: bool = True,
+) -> Compose:
+    """The reference's default pipeline (`utils/hf_dataset_utilities.py:58-81`)."""
+    ts: list[Transform] = [Resize(image_size)]
+    if random_flip:
+        ts.append(RandomHorizontalFlip())
+    ts.append(ToFloat())
+    if convert_rgb:
+        ts.append(GrayscaleToRGB())
+    if normalize_transform:
+        ts.append(Normalize())
+    return Compose(ts)

@@ -1,0 +1,34 @@
+"""Model zoo: ResNet family + small CNNs (flax.linen, NHWC, bf16-ready).
+
+TPU-native re-expression of the reference's L2 model layer (SURVEY.md §1):
+from-scratch ResNet18 (`/root/reference/setup/resnet18.py`), torchvision-style
+ResNet18/34/50 with ImageNet stems, the MNIST `Net` CNN
+(`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:75-92`),
+and frozen-backbone transfer-learning wrappers
+(`/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:141-159`).
+"""
+
+from tpuframe.models.cnn import MnistNet
+from tpuframe.models.resnet import (
+    BasicBlock,
+    Bottleneck,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+)
+from tpuframe.models.transfer import TransferClassifier, backbone_frozen_labels
+
+__all__ = [
+    "MnistNet",
+    "BasicBlock",
+    "Bottleneck",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "TransferClassifier",
+    "backbone_frozen_labels",
+]

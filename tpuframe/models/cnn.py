@@ -1,0 +1,43 @@
+"""Small CNNs for the MNIST-class examples.
+
+Architecture parity with the reference's MNIST ``Net``
+(`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:75-92`):
+two 5x5 VALID convs (10, 20 channels) each followed by 2x2 max-pool, Dropout2d
+on the second conv, 320->50->10 MLP with dropout, log-softmax output (the
+reference trains with ``F.nll_loss`` on log-probs).  Inputs are NHWC
+(N, 28, 28, 1).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistNet(nn.Module):
+    """LeNet-style MNIST classifier returning log-probabilities."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        # Dropout2d drops whole feature maps: broadcast over spatial dims.
+        x = nn.Dropout(
+            rate=0.5,
+            broadcast_dims=(1, 2),
+            deterministic=not train,
+            name="conv2_drop",
+        )(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # (N, 320) for 28x28 inputs
+        x = nn.relu(nn.Dense(50, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(rate=0.5, deterministic=not train, name="fc_drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        return nn.log_softmax(x.astype(jnp.float32))

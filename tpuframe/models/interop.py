@@ -1,0 +1,94 @@
+"""Torch checkpoint import: torchvision-style ResNet state_dicts -> flax params.
+
+The reference's transfer-learning examples start from torchvision pretrained
+weights (`models.resnet18(weights=ResNet18_Weights.DEFAULT)` at
+`/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:146`).
+This container has no egress, and a TPU framework shouldn't depend on
+torchvision at train time anyway — instead, any torchvision-format ResNet
+``state_dict`` (a file the user already has) can be converted into a tpuframe
+ResNet variables tree.  The tpuframe ResNet keeps stable module names
+(``conv1``, ``layer{i}_{j}``, ``downsample_*``, ``fc``) precisely so this
+mapping is mechanical.
+
+Layout conversions:
+- Conv:   torch OIHW  -> flax HWIO
+- Linear: torch (out, in) -> flax (in, out)
+- BatchNorm: weight/bias -> scale/bias (params); running_mean/var -> mean/var
+  (batch_stats collection)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def import_torch_resnet(state_dict: Mapping[str, Any]) -> dict:
+    """Convert a torchvision-format ResNet state_dict to tpuframe variables.
+
+    Accepts tensors or numpy arrays as values (call ``.numpy()`` upstream or
+    pass ``torch.load(..., map_location='cpu')`` output directly).  Returns
+    ``{"params": ..., "batch_stats": ...}`` matching
+    ``tpuframe.models.ResNet{18,34,50,101}``.
+    """
+    params: dict = {}
+    batch_stats: dict = {}
+
+    def to_np(v: Any) -> np.ndarray:
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        return np.asarray(v)
+
+    def put(tree: dict, path: list[str], leaf: np.ndarray) -> None:
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = leaf
+
+    for key, value in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        value = to_np(value)
+        parts = key.split(".")
+        # torchvision names: conv1.weight, bn1.weight, layer1.0.conv2.weight,
+        # layer1.0.downsample.{0,1}.weight, fc.{weight,bias}
+        if parts[0].startswith("layer"):
+            stage, block_idx = parts[0], parts[1]
+            module = f"{stage}_{block_idx}"
+            rest = parts[2:]
+            if rest[0] == "downsample":
+                sub = "downsample_conv" if rest[1] == "0" else "downsample_bn"
+                rest = [sub] + rest[2:]
+            path = [module] + rest
+        else:
+            path = parts
+
+        *mods, attr = path
+        leaf_name, is_stat, array = _convert_leaf(mods[-1], attr, value)
+        if is_stat:
+            put(batch_stats, mods + [leaf_name], array)
+        else:
+            put(params, mods + [leaf_name], array)
+
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def _convert_leaf(module: str, attr: str, value: np.ndarray):
+    """Map one torch leaf to (flax_name, goes_to_batch_stats, converted array)."""
+    is_bn = bool(re.search(r"bn|downsample_bn", module))
+    if is_bn:
+        mapping = {
+            "weight": ("scale", False),
+            "bias": ("bias", False),
+            "running_mean": ("mean", True),
+            "running_var": ("var", True),
+        }
+        name, is_stat = mapping[attr]
+        return name, is_stat, value
+    if value.ndim == 4:  # conv kernel OIHW -> HWIO
+        return "kernel", False, value.transpose(2, 3, 1, 0)
+    if value.ndim == 2:  # linear (out, in) -> (in, out)
+        return "kernel", False, value.T
+    return attr if attr != "weight" else "kernel", False, value

@@ -1,0 +1,178 @@
+"""ResNet family, written TPU-first in flax.linen.
+
+Capability parity with the reference's models (not a port):
+
+- from-scratch CIFAR ResNet18 — `/root/reference/setup/resnet18.py:29-67`
+  (3x3 stride-1 stem + 3x3/s2 maxpool, 4 stages of BasicBlock, adaptive
+  avgpool head) -> ``ResNet18(stem="cifar")``.
+- torchvision-style ResNet18/50 used by the transfer-learning wrappers
+  (`/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:146`,
+  `/root/reference/02_deepspeed/03_1k_imagenet_deepspeed_resnet.py:121-139`)
+  -> ``ResNet18()``/``ResNet50()`` with the classic 7x7/s2 ImageNet stem.
+
+TPU-first choices:
+
+- NHWC layout (XLA's preferred conv layout on TPU) and a ``dtype`` knob for
+  bf16 activations feeding the MXU; params and BN statistics stay float32.
+- No Python control flow on data: the whole forward is trace-once, so it
+  compiles to a single XLA program.
+- BatchNorm under ``jit`` + GSPMD sharding computes batch statistics over the
+  *global* (all-chip) batch: cross-replica sync-BN is the default by
+  construction, the opposite of torch DDP's per-replica BN.  Per-replica
+  statistics are available by running the step under ``shard_map`` instead
+  (see tpuframe.parallel).  SURVEY.md §7 "Hard parts" flags this choice.
+- Module names are stable (``conv1``, ``layer{i}_{j}``, ``fc`` ...) so torch
+  checkpoints can be imported by tpuframe.models.interop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection skip (reference Block,
+    `/root/reference/setup/resnet18.py:3-28`)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), (self.strides, self.strides), name="downsample_conv"
+            )(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck (torchvision ResNet50-style)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(self.filters * self.expansion, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion,
+                (1, 1),
+                (self.strides, self.strides),
+                name="downsample_conv",
+            )(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(y + residual)
+
+
+class ResNet(nn.Module):
+    """Generic 4-stage ResNet over NHWC inputs.
+
+    Args:
+      stage_sizes: blocks per stage, e.g. (2, 2, 2, 2) for ResNet18.
+      block_cls: BasicBlock or Bottleneck.
+      num_classes: classifier width; 0 means "no head" (feature extractor).
+      stem: "imagenet" = 7x7/s2 conv + 3x3/s2 maxpool (torchvision);
+            "cifar" = 3x3/s1 conv + 3x3/s2 maxpool (reference
+            `setup/resnet18.py:35-39` keeps the maxpool even for CIFAR).
+      dtype: activation/compute dtype (bf16 recommended on TPU); params and
+             BN statistics are kept float32.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Type[nn.Module]
+    num_classes: int = 10
+    num_filters: int = 64
+    stem: str = "imagenet"
+    dtype: jnp.dtype = jnp.float32
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            padding="SAME",
+            kernel_init=nn.initializers.he_normal(),
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,  # statistics + affine in f32 for stability
+        )
+
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv1")(x)
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv1")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+        x = norm(name="bn1")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=self.act,
+                    name=f"layer{i + 1}_{j}",
+                )(x)
+                x = x.astype(self.dtype)
+
+        x = jnp.mean(x, axis=(1, 2))  # adaptive avg-pool to (N, C)
+        if self.num_classes:
+            x = nn.Dense(
+                self.num_classes, dtype=self.dtype, name="fc"
+            )(x)
+        return x.astype(jnp.float32)
+
+    @property
+    def feature_width(self) -> int:
+        return self.num_filters * 8 * self.block_cls.expansion
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
+ResNet101 = functools.partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)

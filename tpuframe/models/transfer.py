@@ -1,0 +1,70 @@
+"""Frozen-backbone transfer-learning wrappers.
+
+Capability parity with the reference's inline wrappers — pretrained
+ResNet18/50 with every backbone param frozen and a fresh
+``Dropout(0.5) + Linear`` head sized to the dataset
+(`/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:141-159`,
+`/root/reference/02_deepspeed/03_1k_imagenet_deepspeed_resnet.py:121-139`).
+
+TPU-first differences: freezing is not a mutable ``requires_grad`` flag on the
+module (modules are pure functions here); it is an *optimizer partition* —
+:func:`backbone_frozen_labels` labels the param pytree and
+``optax.multi_transform`` routes backbone leaves to ``set_to_zero`` while the
+head trains.  That keeps the whole model one XLA program (backbone still runs
+on the MXU in bf16) with zero optimizer state for frozen leaves — the same
+memory win ``requires_grad=False`` buys in torch.
+
+Pretrained weights are imported from torch checkpoints via
+``tpuframe.models.interop.import_torch_resnet`` (no torchvision download
+needed at train time).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TransferClassifier(nn.Module):
+    """Backbone (headless) + Dropout(0.5) + Dense head.
+
+    ``backbone`` must be a module returning (N, C) features — e.g.
+    ``ResNet50(num_classes=0)``.  Params land under ``backbone/`` and
+    ``head/`` so freezing partitions are trivial to express.
+    """
+
+    backbone: nn.Module
+    num_classes: int
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # A dataclass-field submodule is named after the field: params land
+        # under params['backbone'] (and head under params['head']).
+        feats = self.backbone(x, train=train)
+        y = nn.Dropout(rate=self.dropout_rate, deterministic=not train, name="head_drop")(
+            feats
+        )
+        y = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(y)
+        return y.astype(jnp.float32)
+
+
+def backbone_frozen_labels(params: Any, frozen_keys: tuple = ("backbone",)) -> Any:
+    """Label a TransferClassifier param tree: 'frozen' backbone, 'trainable' head.
+
+    Use with ``optax.multi_transform({'trainable': tx, 'frozen':
+    optax.set_to_zero()}, labels)`` — the JAX equivalent of the reference's
+    ``param.requires_grad = False`` loop
+    (`02_cifar_torch_distributor_resnet.py:150-151`).
+    """
+    import jax
+
+    return {
+        key: jax.tree_util.tree_map(
+            lambda _: "frozen" if key in frozen_keys else "trainable", sub
+        )
+        for key, sub in params.items()
+    }
