@@ -1,0 +1,170 @@
+"""Sharding-plan tests on the 8-device simulated mesh (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuframe.core import MeshSpec
+from tpuframe.parallel import (
+    ParallelPlan,
+    ZeroConfig,
+    bf16_compute,
+    get_policy,
+    infer_shard_dim,
+    zero_1,
+    zero_3,
+)
+
+
+def tiny_params():
+    return {
+        "dense": {"kernel": jnp.ones((64, 512)), "bias": jnp.ones((512,))},
+        "out": {"kernel": jnp.ones((512, 16)), "bias": jnp.ones((16,))},
+    }
+
+
+class TestInferShardDim:
+    def test_largest_divisible(self):
+        assert infer_shard_dim((64, 512), 4) == 1
+
+    def test_respects_taken(self):
+        assert infer_shard_dim((64, 512), 4, taken=[1]) == 0
+
+    def test_none_when_nothing_divides(self):
+        assert infer_shard_dim((3, 5), 4) is None
+
+
+class TestBatchSharding:
+    def test_data_and_fsdp_axes(self):
+        mesh = MeshSpec(data=2, fsdp=2, model=2).build()
+        plan = ParallelPlan(mesh=mesh)
+        assert plan.batch_spec() == P(("data", "fsdp"))
+        assert plan.dp_size == 4
+
+    def test_pure_dp(self):
+        mesh = MeshSpec(data=-1).build()
+        plan = ParallelPlan(mesh=mesh)
+        batch = plan.shard_batch({"x": np.ones((16, 8))})
+        assert batch["x"].sharding.spec == P(("data",))
+
+
+class TestZeroStages:
+    def test_stage0_replicates_everything(self):
+        mesh = MeshSpec(data=-1).build()
+        plan = ParallelPlan(mesh=mesh, zero_stage=0, min_shard_elems=1)
+        shardings = plan.param_shardings(tiny_params())
+        assert all(
+            s.spec == P() for s in jax.tree.leaves(shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+        )
+
+    def test_stage1_shards_opt_state_not_params(self):
+        mesh = MeshSpec(data=2, fsdp=4).build()
+        plan = ParallelPlan(mesh=mesh, zero_stage=1, min_shard_elems=1)
+        params = tiny_params()
+        tx = optax.adam(1e-3)
+        state = jax.eval_shape(tx.init, params)
+        p_sh = plan.param_shardings(params)
+        assert p_sh["dense"]["kernel"].spec == P()
+        s_sh = plan.state_shardings(state, params)
+        # adam's mu mirrors params: large kernels sharded over fsdp
+        mu_spec = s_sh[0].mu["dense"]["kernel"].spec
+        assert "fsdp" in tuple(mu_spec)
+        # scalar step count replicated
+        assert s_sh[0].count.spec == P()
+
+    def test_stage3_shards_params(self):
+        mesh = MeshSpec(data=2, fsdp=4).build()
+        plan = ParallelPlan(mesh=mesh, zero_stage=3, min_shard_elems=1)
+        params = plan.shard_params(tiny_params())
+        spec = params["dense"]["kernel"].sharding.spec
+        assert "fsdp" in tuple(spec)
+        # bias (16 elems, not divisible by 4... 16 % 4 == 0 actually) — small
+        # leaves below min_shard_elems=1 threshold still shard; check global
+        # value integrity instead
+        np.testing.assert_allclose(np.asarray(params["dense"]["kernel"]), 1.0)
+
+    def test_tp_rule_layered_under_fsdp(self):
+        mesh = MeshSpec(data=2, fsdp=2, model=2).build()
+        plan = ParallelPlan(
+            mesh=mesh,
+            zero_stage=3,
+            rules=(("dense/kernel", P(None, "model")),),
+            min_shard_elems=1,
+        )
+        spec = plan.param_spec("params/dense/kernel", (64, 512))
+        assert spec[1] == "model"
+        assert "fsdp" in tuple(spec)
+
+    def test_from_deepspeed_shaped_dict(self):
+        cfg = ZeroConfig.from_dict(
+            {"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}}}
+        )
+        assert cfg.stage == 3 and cfg.offload_optimizer
+
+    def test_invalid_stage(self):
+        mesh = MeshSpec(data=-1).build()
+        with pytest.raises(ValueError):
+            ParallelPlan(mesh=mesh, zero_stage=4)
+
+
+class TestZeroEndToEnd:
+    """A sharded optimizer update must be numerically identical to the
+    replicated one — ZeRO is a memory layout, not an algorithm change."""
+
+    @pytest.mark.parametrize("stage", [0, 1, 3])
+    def test_update_matches_single_device(self, stage):
+        mesh = MeshSpec(data=2, fsdp=4).build()
+        plan = ParallelPlan(mesh=mesh, zero_stage=stage, min_shard_elems=1)
+        params = tiny_params()
+        tx = optax.adam(1e-2)
+
+        def loss_fn(p, x):
+            h = x @ p["dense"]["kernel"] + p["dense"]["bias"]
+            y = h @ p["out"]["kernel"] + p["out"]["bias"]
+            return jnp.mean(y**2)
+
+        x = np.random.RandomState(0).randn(16, 64).astype(np.float32)
+
+        # reference: plain single-device update
+        ref_state = tx.init(params)
+        ref_grads = jax.grad(loss_fn)(params, x)
+        ref_updates, _ = tx.update(ref_grads, ref_state, params)
+        ref_params = optax.apply_updates(params, ref_updates)
+
+        # sharded: jit with plan-assigned shardings
+        p_sh = plan.param_shardings(params)
+        s_sh = plan.state_shardings(jax.eval_shape(tx.init, params), params)
+
+        @jax.jit
+        def step(p, s, xb):
+            grads = jax.grad(loss_fn)(p, xb)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s
+
+        sharded_params = jax.device_put(params, p_sh)
+        sharded_state = jax.jit(tx.init, out_shardings=s_sh)(sharded_params)
+        new_params, _ = step(
+            sharded_params, sharded_state, plan.shard_batch({"x": x})["x"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_params["dense"]["kernel"]),
+            np.asarray(ref_params["dense"]["kernel"]),
+            rtol=1e-5,
+        )
+
+
+class TestPrecision:
+    def test_bf16_policy_casts(self):
+        policy = bf16_compute()
+        params = {"w": jnp.ones((4, 4)), "step": jnp.array(3, jnp.int32)}
+        cast = policy.cast_params_for_compute(params)
+        assert cast["w"].dtype == jnp.bfloat16
+        assert cast["step"].dtype == jnp.int32  # ints untouched
+
+    def test_get_policy_by_name(self):
+        assert get_policy("bf16").compute_dtype == jnp.bfloat16
+        with pytest.raises(ValueError):
+            get_policy("fp8_nope")

@@ -94,15 +94,22 @@ class MeshSpec:
         return sizes
 
     def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
-        """Construct a named Mesh over ``devices`` (default: all devices)."""
+        """Construct a named Mesh over ``devices`` (default: all devices).
+
+        Axis types are ``Auto`` (GSPMD propagation): tpuframe's ParallelPlan
+        constrains inputs/outputs and lets the partitioner place every
+        intermediate — jax 0.9's ``make_mesh`` default of ``Explicit`` would
+        instead demand a sharding proof per op.
+        """
         devices = list(devices) if devices is not None else jax.devices()
         sizes = self.resolve(len(devices))
         shape = tuple(sizes[name] for name in AXIS_ORDER)
+        auto = (jax.sharding.AxisType.Auto,) * len(AXIS_ORDER)
         if devices == jax.devices():
             # jax.make_mesh picks an ICI-friendly physical ordering.
-            return jax.make_mesh(shape, AXIS_ORDER)
+            return jax.make_mesh(shape, AXIS_ORDER, axis_types=auto)
         grid = np.asarray(devices).reshape(shape)
-        return Mesh(grid, AXIS_ORDER)
+        return Mesh(grid, AXIS_ORDER, axis_types=auto)
 
     @classmethod
     def from_config(cls, cfg: Mapping[str, int]) -> "MeshSpec":

@@ -22,6 +22,14 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 
+def item_rng(seed: int, epoch: int, idx: int) -> np.random.Generator:
+    """Per-item augmentation RNG: deterministic in (seed, epoch, idx) so runs
+    reproduce exactly and every epoch re-randomizes.  One formula shared by
+    every dataset class — augmentation randomness must not change when a
+    pipeline switches dataset implementations."""
+    return np.random.default_rng((seed * 1_000_003 + epoch) * 1_000_003 + idx)
+
+
 class ArrayDataset:
     """In-memory (images, labels) with optional per-item transform.
 
@@ -54,10 +62,7 @@ class ArrayDataset:
     def __getitem__(self, idx: int):
         image = self.images[idx]
         if self.transform is not None:
-            rng = np.random.default_rng(
-                (self.rng_seed * 1_000_003 + self.epoch) * 1_000_003 + idx
-            )
-            image = self.transform(image, rng)
+            image = self.transform(image, item_rng(self.rng_seed, self.epoch, idx))
         return np.asarray(image), int(self.labels[idx])
 
 
@@ -151,10 +156,7 @@ class SyntheticImageDataset:
         # class-conditional brightness shift makes the task learnable
         img = np.clip(img.astype(np.int32) + label * 8, 0, 255).astype(np.uint8)
         if self.transform is not None:
-            t_rng = np.random.default_rng(
-                (self.seed * 1_000_003 + self.epoch) * 1_000_003 + idx
-            )
-            img = self.transform(img, t_rng)
+            img = self.transform(img, item_rng(self.seed, self.epoch, idx))
         return np.asarray(img), label
 
 

@@ -105,8 +105,11 @@ class DataLoader:
         per_proc = self._per_process_count()
         total = per_proc * self.process_count
         if total > n:
-            order = np.concatenate([order, order[: total - n]])
-            genuine = np.concatenate([genuine, np.zeros(total - n, bool)])
+            # np.resize repeats cyclically, so the pad stays correct even when
+            # it exceeds the dataset size (tiny dataset, many processes).
+            order = np.resize(order, total)
+            genuine = np.zeros(total, bool)
+            genuine[:n] = True
         else:
             order, genuine = order[:total], genuine[:total]
         sl = slice(self.process_index, None, self.process_count)

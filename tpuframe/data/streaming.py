@@ -31,6 +31,8 @@ from collections import OrderedDict
 from typing import Any, Callable, Mapping
 
 import msgpack
+
+from tpuframe.data.datasets import item_rng
 import numpy as np
 
 INDEX_NAME = "index.json"
@@ -210,7 +212,9 @@ class StreamingDataset:
         decoded_cache_shards: int = 2,
         fetcher: Callable[[str, str], None] = _default_fetcher,
         validate_checksum: bool = True,
+        rng_seed: int = 0,
     ):
+        self.rng_seed = rng_seed
         self.remote = remote
         self.local_cache = local_cache
         self.transform = transform
@@ -225,7 +229,9 @@ class StreamingDataset:
             os.makedirs(local_cache, exist_ok=True)
             local_index = os.path.join(local_cache, INDEX_NAME)
             if not os.path.exists(local_index):
-                fetcher(index_path, local_index)
+                tmp = local_index + ".tmp"
+                fetcher(index_path, tmp)
+                os.replace(tmp, local_index)  # atomic, like shard fetches
             index_path = local_index
         with open(index_path) as f:
             self.index = json.load(f)
@@ -297,8 +303,7 @@ class StreamingDataset:
         rec = self.sample(int(idx))
         image = rec[self.image_key]
         if self.transform is not None:
-            rng = np.random.default_rng((self.epoch * 1_000_003) + int(idx))
-            image = self.transform(image, rng)
+            image = self.transform(image, item_rng(self.rng_seed, self.epoch, int(idx)))
         return np.asarray(image), int(rec[self.label_key])
 
 

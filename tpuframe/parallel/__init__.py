@@ -1,0 +1,49 @@
+"""Parallelism: sharding plans (DP / ZeRO / TP), precision policies.
+
+TPU-native re-expression of the reference's parallelism inventory
+(SURVEY.md §2.2): DDP replication, DeepSpeed ZeRO stages, and tensor-parallel
+hooks, all as declarative shardings over the core mesh — XLA inserts the
+collectives the reference performed imperatively through NCCL.
+"""
+
+from tpuframe.parallel.precision import (
+    Policy,
+    bf16_compute,
+    full_precision,
+    get_policy,
+    pure_bf16,
+)
+from tpuframe.parallel.sharding import (
+    ParallelPlan,
+    Rule,
+    infer_shard_dim,
+    path_str,
+)
+from tpuframe.parallel.zero import (
+    ZeroConfig,
+    host_offload_sharding,
+    supports_host_offload,
+    zero_0,
+    zero_1,
+    zero_2,
+    zero_3,
+)
+
+__all__ = [
+    "Policy",
+    "bf16_compute",
+    "full_precision",
+    "get_policy",
+    "pure_bf16",
+    "ParallelPlan",
+    "Rule",
+    "infer_shard_dim",
+    "path_str",
+    "ZeroConfig",
+    "host_offload_sharding",
+    "supports_host_offload",
+    "zero_0",
+    "zero_1",
+    "zero_2",
+    "zero_3",
+]

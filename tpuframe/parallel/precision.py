@@ -1,0 +1,97 @@
+"""Mixed-precision policy: bf16 compute on the MXU, fp32 master params.
+
+The reference engages bf16 only through DeepSpeed config
+(`/root/reference/02_deepspeed/deepspeed_config.py:24-26`, ``bf16.enabled``).
+On TPU, bf16 is the native MXU input format, so the policy is a first-class
+object here: params/optimizer state stay float32 (master weights), activations
+and matmul inputs are cast to bfloat16, and loss/reductions come back in
+float32.  This is the same split DeepSpeed's bf16 engine performs, expressed
+as pure dtype casts that XLA fuses into the surrounding ops for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cast_floating(tree: Any, dtype: jnp.dtype) -> Any:
+    """Cast floating-point array leaves (jax *or* numpy — host batches from
+    tpuframe.data arrive as numpy) to ``dtype``; leave ints/bools alone."""
+
+    def cast(x):
+        leaf_dtype = getattr(x, "dtype", None)
+        if leaf_dtype is not None and jnp.issubdtype(leaf_dtype, np.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype assignment for the three tensor populations in a train step.
+
+    - ``param_dtype``: master copies held between steps (and in checkpoints).
+    - ``compute_dtype``: what the forward/backward runs in (MXU wants bf16).
+    - ``output_dtype``: loss and metric accumulations.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_params_for_compute(self, params: Any) -> Any:
+        return _cast_floating(params, self.compute_dtype)
+
+    def cast_batch(self, batch: Any) -> Any:
+        return _cast_floating(batch, self.compute_dtype)
+
+    def cast_outputs(self, outputs: Any) -> Any:
+        return _cast_floating(outputs, self.output_dtype)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.param_dtype)
+
+
+def full_precision() -> Policy:
+    return Policy()
+
+
+def bf16_compute() -> Policy:
+    """The standard TPU policy: fp32 master params, bf16 compute, fp32 loss."""
+    return Policy(compute_dtype=jnp.bfloat16)
+
+
+def pure_bf16() -> Policy:
+    """Everything bf16 (max HBM savings; use only with loss-scale-free optimizers)."""
+    return Policy(
+        param_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16,
+        output_dtype=jnp.float32,
+    )
+
+
+_NAMED = {
+    "fp32": full_precision,
+    "float32": full_precision,
+    "bf16": bf16_compute,
+    "bfloat16": bf16_compute,
+    "pure_bf16": pure_bf16,
+}
+
+
+def get_policy(name: str | Policy) -> Policy:
+    """Resolve a policy by name (config-file friendly)."""
+    if isinstance(name, Policy):
+        return name
+    try:
+        return _NAMED[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {name!r}; known: {sorted(_NAMED)}"
+        ) from None
