@@ -1,0 +1,324 @@
+"""Train-engine tests: jitted steps, algorithms, durations, Trainer loop.
+
+Covers the reference's de-facto validation strategy (SURVEY.md §4): local
+smoke run, 1-epoch cheap run, loss-falls regression signal, post-train
+inference spot check — on the 8-device simulated mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.core import MeshSpec
+from tpuframe.core import runtime as rt
+from tpuframe.data import DataLoader, SyntheticImageDataset
+from tpuframe.models import MnistNet, ResNet18
+from tpuframe.parallel import ParallelPlan
+from tpuframe.train import (
+    CutMix,
+    Duration,
+    EarlyStopping,
+    LabelSmoothing,
+    MixUp,
+    Trainer,
+    create_train_state,
+    cross_entropy,
+    make_eval_step,
+    make_grad_accum_step,
+    make_train_step,
+    param_count,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    rt.reset_runtime()
+    rt.initialize(MeshSpec(data=-1))
+    yield
+    rt.reset_runtime()
+
+
+def small_state(num_classes=10, image=28, channels=1, plan=None):
+    model = MnistNet(num_classes=num_classes)
+    return model, create_train_state(
+        model,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, image, image, channels)),
+        optax.adam(1e-3),
+        plan=plan,
+        init_kwargs={"train": False},
+    )
+
+
+class TestDuration:
+    def test_parse(self):
+        assert Duration.parse("2ep") == Duration(2, "ep")
+        assert Duration.parse("500ba").unit == "ba"
+        assert Duration.parse(3) == Duration(3, "ep")
+
+    def test_reached(self):
+        d = Duration.parse("2ep")
+        assert not d.reached(epoch=1, batch=999, samples=0)
+        assert d.reached(epoch=2, batch=0, samples=0)
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            Duration.parse("2 epochs")
+
+
+class TestSteps:
+    def test_train_step_reduces_loss(self):
+        _, state = small_state()
+        step = make_train_step()
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 28, 28, 1).astype(np.float32)
+        y = (x.mean((1, 2, 3)) > 0.5).astype(np.int32)  # learnable from pixels
+        batch = {"image": x, "label": y}
+        first = None
+        for i in range(20):
+            state, metrics = step(state, batch)
+            if first is None:
+                first = float(metrics["loss_sum"])
+        assert float(metrics["loss_sum"]) < first
+
+    def test_eval_step_weight_mask(self):
+        _, state = small_state()
+        estep = make_eval_step()
+        x = np.random.RandomState(0).rand(8, 28, 28, 1).astype(np.float32)
+        y = np.zeros(8, np.int32)
+        full = estep(state, {"image": x, "label": y})
+        half = estep(
+            state,
+            {
+                "image": x,
+                "label": y,
+                "weight": np.array([1, 1, 1, 1, 0, 0, 0, 0], np.float32),
+            },
+        )
+        assert float(full["count"]) == 8.0
+        assert float(half["count"]) == 4.0
+
+    def test_soft_labels(self):
+        logits = jnp.array([[2.0, 0.0], [0.0, 2.0]])
+        hard = cross_entropy(logits, jnp.array([0, 1]))
+        soft = cross_entropy(logits, jnp.array([[1.0, 0.0], [0.0, 1.0]]))
+        np.testing.assert_allclose(np.asarray(hard), np.asarray(soft), rtol=1e-6)
+
+    def test_grad_accum_matches_large_batch(self):
+        """2 microbatches of 16 must equal one batch of 32 — requires a
+        deterministic model (no dropout/BN noise between the two paths)."""
+        import flax.linen as nn
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(10)(x.reshape((x.shape[0], -1)))
+
+        def mk():
+            return create_train_state(
+                Tiny(),
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 28, 28, 1)),
+                optax.adam(1e-3),
+                init_kwargs={"train": False},
+            )
+
+        state_a, state_b = mk(), mk()
+        rng = np.random.RandomState(1)
+        x = rng.rand(32, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, 32).astype(np.int32)
+
+        big = make_train_step(donate=False)
+        accum = make_grad_accum_step(2, donate=False)
+        state_a, ma = big(state_a, {"image": x, "label": y})
+        state_b, mb = accum(
+            state_b, {"image": x.reshape(2, 16, 28, 28, 1), "label": y.reshape(2, 16)}
+        )
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(state_a.params)[0]),
+            np.asarray(jax.tree.leaves(state_b.params)[0]),
+            atol=1e-6,
+        )
+        assert float(mb["count"]) == 32.0
+
+    def test_param_count(self):
+        _, state = small_state()
+        assert param_count(state) > 10_000
+
+
+class TestAlgorithms:
+    def _batch(self):
+        rng = np.random.RandomState(0)
+        return rng.rand(16, 32, 32, 3).astype(np.float32), rng.randint(
+            0, 10, 16
+        ).astype(np.int32)
+
+    def test_label_smoothing(self):
+        x, y = self._batch()
+        xs, ys = LabelSmoothing(0.1, num_classes=10).apply(
+            x, y, np.random.default_rng(0)
+        )
+        assert ys.shape == (16, 10)
+        np.testing.assert_allclose(ys.sum(-1), 1.0, rtol=1e-6)
+        assert ys.max() <= 0.91
+
+    def test_cutmix_preserves_label_mass(self):
+        x, y = self._batch()
+        xs, ys = CutMix(1.0, num_classes=10).apply(x, y, np.random.default_rng(0))
+        assert xs.shape == x.shape
+        np.testing.assert_allclose(ys.sum(-1), 1.0, rtol=1e-5)
+
+    def test_mixup(self):
+        x, y = self._batch()
+        xs, ys = MixUp(0.2, num_classes=10).apply(x, y, np.random.default_rng(0))
+        np.testing.assert_allclose(ys.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestTrainerLoop:
+    def _loaders(self, n=64, classes=4, size=28):
+        train = SyntheticImageDataset(
+            n=n, num_classes=classes, image_size=size, channels=1
+        )
+        evald = SyntheticImageDataset(
+            n=32, num_classes=classes, image_size=size, channels=1, seed=9
+        )
+        lt = DataLoader(train, batch_size=16, shuffle=True,
+                        process_index=0, process_count=1)
+        le = DataLoader(evald, batch_size=16, drop_last=False,
+                        process_index=0, process_count=1)
+        return lt, le
+
+    def test_one_epoch_fit(self):
+        lt, le = self._loaders()
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            eval_dataloader=le,
+            max_duration="1ep",
+            lr=1e-3,
+            num_classes=4,
+        )
+        result = trainer.fit()
+        assert "train_loss" in result.metrics
+        assert "eval_accuracy" in result.metrics
+        assert len(result.history) == 1
+        assert trainer.batches_seen == 4  # 64 / 16
+
+    def test_duration_in_batches(self):
+        lt, _ = self._loaders()
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            max_duration="2ba",
+            num_classes=4,
+        )
+        trainer.fit()
+        assert trainer.batches_seen == 2
+
+    def test_loss_falls_over_epochs(self):
+        lt, _ = self._loaders(n=128)
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            max_duration="4ep",
+            lr=3e-3,
+            num_classes=4,
+            log_interval=0,
+        )
+        result = trainer.fit()
+        assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
+
+    def test_algorithms_in_loop(self):
+        lt, le = self._loaders()
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            eval_dataloader=le,
+            max_duration="1ep",
+            algorithms=[LabelSmoothing(0.1), CutMix(1.0)],
+            num_classes=4,
+        )
+        result = trainer.fit()
+        assert np.isfinite(result.metrics["train_loss"])
+
+    def test_early_stopping(self):
+        lt, le = self._loaders()
+        stopper = EarlyStopping(monitor="eval_loss", patience=1)
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            eval_dataloader=le,
+            max_duration="50ep",
+            lr=0.0,  # loss can never improve -> must stop early
+            callbacks=[stopper],
+            num_classes=4,
+        )
+        result = trainer.fit()
+        assert result.stopped_reason is not None
+        assert trainer.epoch < 50
+
+    def test_logger_receives_metrics(self):
+        class Capture:
+            def __init__(self):
+                self.metrics, self.params = [], []
+
+            def log_metrics(self, m, step):
+                self.metrics.append((step, m))
+
+            def log_params(self, p):
+                self.params.append(p)
+
+        cap = Capture()
+        lt, _ = self._loaders()
+        Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            max_duration="1ep",
+            loggers=[cap],
+            num_classes=4,
+            log_interval=2,
+        ).fit()
+        assert cap.params and cap.metrics
+
+    def test_predict_spot_check(self):
+        lt, _ = self._loaders()
+        trainer = Trainer(
+            MnistNet(num_classes=4), train_dataloader=lt, max_duration="1ep",
+            num_classes=4,
+        )
+        trainer.fit()
+        img, _ = lt.dataset[0]
+        logits = trainer.predict(np.asarray(img)[None])
+        assert logits.shape == (1, 4)
+
+
+class TestTrainerSharded:
+    def test_zero3_resnet_epoch(self):
+        """Full Trainer epoch with ZeRO-3 params over a dp2 x fsdp4 mesh."""
+        rt.reset_runtime()
+        runtime = rt.initialize(MeshSpec(data=2, fsdp=4))
+        plan = ParallelPlan(mesh=runtime.mesh, zero_stage=3, min_shard_elems=128)
+        train = SyntheticImageDataset(n=32, num_classes=4, image_size=32, channels=3)
+        lt = DataLoader(train, batch_size=16, process_index=0, process_count=1)
+        trainer = Trainer(
+            ResNet18(num_classes=4, stem="cifar"),
+            train_dataloader=lt,
+            max_duration="1ep",
+            plan=plan,
+            precision="bf16",
+            num_classes=4,
+        )
+        result = trainer.fit()
+        assert np.isfinite(result.metrics["train_loss"])
+        # ZeRO-3: at least one large param is genuinely sharded over fsdp
+        specs = jax.tree.leaves(
+            jax.tree.map(
+                lambda x: x.sharding.spec,
+                trainer.state.params,
+                is_leaf=lambda x: hasattr(x, "sharding"),
+            ),
+            is_leaf=lambda s: True,
+        )
+        assert any("fsdp" in tuple(jax.tree.leaves(list(s), is_leaf=lambda e: True)) or "fsdp" in str(s) for s in specs)

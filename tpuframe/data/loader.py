@@ -173,12 +173,13 @@ class DevicePrefetcher:
         self.sharding = sharding
         self.depth = max(1, depth)
 
-    def _put(self, batch: tuple) -> tuple:
-        return tuple(
-            jax.make_array_from_process_local_data(
+    def _put(self, batch):
+        """Any pytree of host arrays (tuple / dict / nested) -> global Arrays."""
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
                 self.sharding_for(np.asarray(x)), np.asarray(x)
-            )
-            for x in batch
+            ),
+            batch,
         )
 
     def sharding_for(self, x: np.ndarray):

@@ -229,9 +229,11 @@ class StreamingDataset:
             os.makedirs(local_cache, exist_ok=True)
             local_index = os.path.join(local_cache, INDEX_NAME)
             if not os.path.exists(local_index):
-                tmp = local_index + ".tmp"
+                # per-process tmp name: concurrent initializers must not
+                # interleave writes into one tmp file
+                tmp = f"{local_index}.{os.getpid()}.tmp"
                 fetcher(index_path, tmp)
-                os.replace(tmp, local_index)  # atomic, like shard fetches
+                os.replace(tmp, local_index)  # atomic promote
             index_path = local_index
         with open(index_path) as f:
             self.index = json.load(f)
@@ -254,7 +256,7 @@ class StreamingDataset:
             return os.path.join(self.remote, shard["file"])
         local = os.path.join(self.local_cache, shard["file"])
         if not os.path.exists(local):
-            tmp = local + ".tmp"
+            tmp = f"{local}.{os.getpid()}.tmp"
             self.fetcher(os.path.join(self.remote, shard["file"]), tmp)
             os.replace(tmp, local)  # atomic: concurrent workers see full files
         return local

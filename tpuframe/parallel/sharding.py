@@ -32,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, MODEL_AXIS
+from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS
 
 #: A tensor-parallel rule: (regex over the param path, PartitionSpec).
 Rule = tuple[str, P]
@@ -125,6 +125,13 @@ class ParallelPlan:
         size = self.axis_size(self.fsdp_axis)
         if size <= 1 or int(np.prod(shape)) < self.min_shard_elems:
             return base
+        # a TP rule may already place fsdp; a duplicate axis is illegal
+        named = {
+            a for e in base if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))
+        }
+        if self.fsdp_axis in named:
+            return base
         entries = list(base) + [None] * (len(shape) - len(base))
         taken = [i for i, e in enumerate(entries) if e is not None]
         dim = infer_shard_dim(shape, size, taken)
@@ -140,8 +147,15 @@ class ParallelPlan:
         return spec
 
     def _state_spec(self, path: str, shape: Sequence[int]) -> P:
-        """Optimizer-state leaves: follow params, plus fsdp for stage>=1."""
+        """Optimizer-state leaves: follow params, plus fsdp for stage>=1.
+
+        A state leaf can have lower rank than the param it mirrors (e.g.
+        adafactor's row/col factors); the param's TP rule spec is then
+        meaningless for it, so it falls back to plain fsdp inference.
+        """
         spec = self._rule_spec(path) or P()
+        if len(spec) > len(shape):
+            spec = P()
         if self.zero_stage >= 1:
             spec = self._maybe_fsdp(shape, spec)
         return spec

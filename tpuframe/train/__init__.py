@@ -1,0 +1,55 @@
+"""Training engine: jitted steps, TrainState, high-level Trainer, algorithms.
+
+TPU-native re-expression of the reference's L4 layer (SURVEY.md §1): the
+Composer Trainer shape, the DDP epoch loop, Accelerate's low-level step feel,
+and Ray Train's structured results, all on one donated jitted XLA step.
+"""
+
+from tpuframe.train.algorithms import (
+    Algorithm,
+    ChannelsLast,
+    CutMix,
+    LabelSmoothing,
+    MixUp,
+    apply_algorithms,
+    resolve_algorithms,
+)
+from tpuframe.train.callbacks import Callback, EarlyStopping, ProgressLogger
+from tpuframe.train.duration import Duration
+from tpuframe.train.state import TrainState, create_train_state, param_count
+from tpuframe.train.step import (
+    cross_entropy,
+    make_eval_step,
+    make_grad_accum_step,
+    make_predict_fn,
+    make_train_step,
+    merge_metrics,
+    summarize_metrics,
+)
+from tpuframe.train.trainer import FitResult, Trainer
+
+__all__ = [
+    "Algorithm",
+    "ChannelsLast",
+    "CutMix",
+    "LabelSmoothing",
+    "MixUp",
+    "apply_algorithms",
+    "resolve_algorithms",
+    "Callback",
+    "EarlyStopping",
+    "ProgressLogger",
+    "Duration",
+    "TrainState",
+    "create_train_state",
+    "param_count",
+    "cross_entropy",
+    "make_eval_step",
+    "make_grad_accum_step",
+    "make_predict_fn",
+    "make_train_step",
+    "merge_metrics",
+    "summarize_metrics",
+    "FitResult",
+    "Trainer",
+]

@@ -1,0 +1,106 @@
+"""Train state: one pytree holding everything a step updates.
+
+Replaces the reference's scattered mutable objects — ``model`` +
+``optimizer`` + implicit BN buffers inside torch modules
+(`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:282-291`)
+— with a single immutable :class:`TrainState` that jit can donate and a
+ParallelPlan can shard leaf-by-leaf.  Checkpoints serialize exactly this
+object (plus step metadata), which is what makes resume trivial.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpuframe.parallel.sharding import ParallelPlan
+
+
+class TrainState(flax.struct.PyTreeNode):
+    """Params + optimizer state + mutable model collections + step counter.
+
+    ``apply_fn``/``tx`` are static (not traced); everything else is data.
+    ``batch_stats`` carries BatchNorm running statistics (flax's ``mutable``
+    collection) — empty dict for stat-free models.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any
+    rng: jax.Array
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: Any, **changes: Any) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            **changes,
+        )
+
+    def step_rng(self, name: str = "dropout") -> jax.Array:
+        """Per-step, per-collection RNG derived from the state's base key."""
+        key = jax.random.fold_in(self.rng, self.step)
+        return jax.random.fold_in(key, hash(name) % (2**31))
+
+
+def create_train_state(
+    model: Any,
+    rng: jax.Array,
+    sample_input: jax.Array,
+    tx: optax.GradientTransformation,
+    plan: ParallelPlan | None = None,
+    init_kwargs: dict | None = None,
+) -> TrainState:
+    """Initialize a TrainState, sharded per ``plan`` from the very first byte.
+
+    With a plan, initialization runs under jit with ``out_shardings`` so
+    ZeRO-3 params materialize *already sharded* — no single-device spike,
+    the property DeepSpeed stage-3 buys with ``zero.Init()``.
+    """
+    init_kwargs = dict(init_kwargs or {})
+    params_rng, dropout_rng, state_rng = jax.random.split(rng, 3)
+
+    def init_fn():
+        variables = model.init(
+            {"params": params_rng, "dropout": dropout_rng},
+            sample_input,
+            **init_kwargs,
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return params, batch_stats, tx.init(params)
+
+    if plan is None:
+        params, batch_stats, opt_state = init_fn()
+    else:
+        a_params, a_stats, a_opt = jax.eval_shape(init_fn)
+        shardings = (
+            plan.param_shardings(a_params),
+            plan.param_shardings(a_stats),
+            plan.state_shardings(a_opt, a_params),
+        )
+        params, batch_stats, opt_state = jax.jit(init_fn, out_shardings=shardings)()
+
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        batch_stats=batch_stats,
+        rng=state_rng,
+        apply_fn=model.apply,
+        tx=tx,
+    )
+
+
+def param_count(state_or_params: Any) -> int:
+    params = getattr(state_or_params, "params", state_or_params)
+    return sum(int(x.size) for x in jax.tree.leaves(params))

@@ -1,0 +1,227 @@
+"""Jitted train/eval step factories — the framework's hot loop.
+
+The reference's per-batch body (H2D copy -> forward -> loss -> backward ->
+allreduce -> optimizer.step, `/root/reference/01_torch_distributor/
+01_basic_torch_distributor.py:224-230`) compiles here into ONE XLA program:
+forward+backward+update fused, gradients all-reduced (or reduce-scattered
+under ZeRO) by the partitioner over ICI, input batch donated, bf16 on the MXU.
+
+Factories return plain jitted callables — the high-level Trainer wraps them,
+but they are equally the "Accelerate-style" low-level API (SURVEY.md §7:
+train/ exposes both levels).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpuframe.parallel.precision import Policy, full_precision
+from tpuframe.parallel.sharding import ParallelPlan
+from tpuframe.train.state import TrainState
+
+#: loss_fn(logits, labels) -> per-example losses, pluggable.
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Integer-label softmax cross entropy (≈ reference's ``nll_loss`` after
+    log_softmax, `01_basic_torch_distributor.py:90-92,226`).  Supports soft
+    labels (N, C) for CutMix/LabelSmoothing mixtures."""
+    if labels.ndim == logits.ndim:
+        return optax.softmax_cross_entropy(logits, labels)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def _forward(state: TrainState, params: Any, batch: Mapping[str, jax.Array],
+             policy: Policy, train: bool, rng: jax.Array | None,
+             loss_fn: LossFn):
+    """Shared forward: handles batch_stats mutability and dropout rngs."""
+    variables = {"params": policy.cast_params_for_compute(params)}
+    has_stats = bool(jax.tree.leaves(state.batch_stats))
+    if has_stats:
+        variables["batch_stats"] = state.batch_stats
+    kwargs: dict[str, Any] = {"train": train}
+    if train and rng is not None:
+        kwargs["rngs"] = {"dropout": rng}
+    x = policy.cast_batch(batch["image"])
+    if train and has_stats:
+        logits, updates = state.apply_fn(
+            variables, x, mutable=["batch_stats"], **kwargs
+        )
+        new_stats = updates["batch_stats"]
+    else:
+        logits = state.apply_fn(variables, x, **kwargs)
+        new_stats = state.batch_stats
+    logits = policy.cast_outputs(logits)
+    losses = loss_fn(logits, batch["label"])
+    return losses, logits, new_stats
+
+
+def make_train_step(
+    policy: Policy | None = None,
+    loss_fn: LossFn = cross_entropy,
+    donate: bool = True,
+) -> Callable[[TrainState, Mapping[str, jax.Array]], tuple[TrainState, dict]]:
+    """Build the jitted train step: (state, batch) -> (state, metrics).
+
+    Metrics are summed (loss_sum, correct, count) so they aggregate exactly
+    across microbatches and hosts — the mean is taken by whoever logs.
+    """
+    policy = policy or full_precision()
+
+    def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        rng = state.step_rng("dropout")
+
+        def compute_loss(params):
+            losses, logits, new_stats = _forward(
+                state, params, batch, policy, True, rng, loss_fn
+            )
+            return jnp.mean(losses), (logits, new_stats)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        new_state = state.apply_gradients(grads, batch_stats=new_stats)
+        labels = batch["label"]
+        hard = labels if labels.ndim == 1 else jnp.argmax(labels, -1)
+        n = jnp.asarray(labels.shape[0], jnp.float32)
+        metrics = {
+            "loss_sum": loss * n,
+            "correct": jnp.sum(jnp.argmax(logits, -1) == hard).astype(jnp.float32),
+            "count": n,
+        }
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    policy: Policy | None = None,
+    loss_fn: LossFn = cross_entropy,
+) -> Callable[[TrainState, Mapping[str, jax.Array]], dict]:
+    """Jitted eval step: (state, batch) -> summed metrics.
+
+    ``batch["weight"]`` (0/1 per example) masks wrap-around-padded duplicates
+    the DataLoader adds to equalize per-host counts — eval never double-counts
+    (the reference's rank-0-only eval sidesteps this by not distributing eval
+    at all, `01_basic_torch_distributor.py:302-323`)."""
+    policy = policy or full_precision()
+
+    def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        losses, logits, _ = _forward(
+            state, state.params, batch, policy, False, None, loss_fn
+        )
+        labels = batch["label"]
+        hard = labels if labels.ndim == 1 else jnp.argmax(labels, -1)
+        weight = batch.get("weight")
+        if weight is None:
+            weight = jnp.ones_like(losses)
+        weight = weight.astype(jnp.float32)
+        return {
+            "loss_sum": jnp.sum(losses * weight),
+            "correct": jnp.sum(
+                (jnp.argmax(logits, -1) == hard).astype(jnp.float32) * weight
+            ),
+            "count": jnp.sum(weight),
+        }
+
+    return jax.jit(step)
+
+
+def make_predict_fn(
+    policy: Policy | None = None,
+) -> Callable[[TrainState, jax.Array], jax.Array]:
+    """Jitted logits fn for inference (the reference's ``predict_image``
+    path, `02_cifar_torch_distributor_resnet.py:370-387`)."""
+    policy = policy or full_precision()
+
+    def predict(state: TrainState, x: jax.Array) -> jax.Array:
+        variables = {"params": policy.cast_params_for_compute(state.params)}
+        if jax.tree.leaves(state.batch_stats):
+            variables["batch_stats"] = state.batch_stats
+        logits = state.apply_fn(variables, policy.cast_batch(x), train=False)
+        return policy.cast_outputs(logits)
+
+    return jax.jit(predict)
+
+
+def make_grad_accum_step(
+    n_microbatches: int,
+    policy: Policy | None = None,
+    loss_fn: LossFn = cross_entropy,
+    donate: bool = True,
+):
+    """Gradient accumulation over leading-dim microbatches via ``lax.scan``.
+
+    Batch arrays must be shaped (n_microbatches, micro_size, ...).  Grads are
+    averaged across microbatches; BN stats roll forward through the scan.
+    Replaces DeepSpeed's ``gradient_accumulation_steps: auto``
+    (`/root/reference/02_deepspeed/deepspeed_config.py:17`).
+    """
+    policy = policy or full_precision()
+
+    def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        rng = state.step_rng("dropout")
+        zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+
+        def micro(carry, mb):
+            grads_acc, stats, metrics = carry
+
+            def compute_loss(params):
+                losses, logits, new_stats = _forward(
+                    state.replace(batch_stats=stats),
+                    params, mb, policy, True, rng, loss_fn,
+                )
+                return jnp.mean(losses), (logits, new_stats)
+
+            (loss, (logits, new_stats)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(state.params)
+            labels = mb["label"]
+            hard = labels if labels.ndim == 1 else jnp.argmax(labels, -1)
+            n = jnp.asarray(labels.shape[0], jnp.float32)
+            metrics = {
+                "loss_sum": metrics["loss_sum"] + loss * n,
+                "correct": metrics["correct"]
+                + jnp.sum(jnp.argmax(logits, -1) == hard).astype(jnp.float32),
+                "count": metrics["count"] + n,
+            }
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            return (grads_acc, new_stats, metrics), None
+
+        init_metrics = {
+            "loss_sum": jnp.zeros(()),
+            "correct": jnp.zeros(()),
+            "count": jnp.zeros(()),
+        }
+        (grads, new_stats, metrics), _ = jax.lax.scan(
+            micro, (zero_grads, state.batch_stats, init_metrics), batch
+        )
+        grads = jax.tree.map(lambda g: g / n_microbatches, grads)
+        new_state = state.apply_gradients(grads, batch_stats=new_stats)
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def merge_metrics(acc: dict | None, new: Mapping[str, jax.Array]) -> dict:
+    """Host-side accumulation of summed metrics across steps."""
+    new = {k: float(v) for k, v in new.items()}
+    if acc is None:
+        return new
+    return {k: acc.get(k, 0.0) + v for k, v in new.items()}
+
+
+def summarize_metrics(acc: Mapping[str, float], prefix: str = "") -> dict:
+    """Summed metrics -> {loss, accuracy} means."""
+    count = max(acc.get("count", 0.0), 1.0)
+    out = {
+        f"{prefix}loss": acc.get("loss_sum", 0.0) / count,
+        f"{prefix}accuracy": acc.get("correct", 0.0) / count,
+    }
+    return out
