@@ -1,0 +1,111 @@
+"""Checkpoint save/restore round-trips (SURVEY.md §4 item 5: save -> reload
+-> eval is the reference's de-facto acceptance test)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.ckpt import (
+    Checkpointer,
+    best_checkpoint_path,
+    latest_step,
+    load_pytree,
+    save_pytree,
+)
+from tpuframe.core import MeshSpec
+from tpuframe.models import MnistNet
+from tpuframe.parallel import ParallelPlan
+from tpuframe.train import create_train_state, make_train_step
+
+
+def _state(plan=None):
+    model = MnistNet(num_classes=10)
+    return create_train_state(
+        model,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 28, 28, 1)),
+        optax.adam(1e-3),
+        plan=plan,
+        init_kwargs={"train": False},
+    )
+
+
+def _batch(n=8):
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, size=(n,)),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _state()
+    step_fn = make_train_step(donate=False)
+    state, _ = step_fn(state, _batch())
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        path = ckpt.save(state, metrics={"loss": 1.0}, meta={"epoch": 1})
+        ckpt.wait()
+        assert latest_step(tmp_path / "ckpt") == 1
+
+        fresh = _state()
+        restored, meta = ckpt.restore(fresh)
+    assert meta == {"epoch": 1}
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert "1" in path
+
+
+def test_maybe_restore_empty_passthrough(tmp_path):
+    state = _state()
+    with Checkpointer(tmp_path / "none") as ckpt:
+        out, meta = ckpt.maybe_restore(state)
+    assert out is state and meta is None
+
+
+def test_restore_onto_sharded_template(tmp_path):
+    """A checkpoint written replicated restores onto a ZeRO-sharded state."""
+    state = _state()
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        ckpt.save(state, step=0)
+        ckpt.wait()
+        mesh = MeshSpec(data=2, fsdp=4).build()
+        plan = ParallelPlan(mesh=mesh, zero_stage=3, min_shard_elems=2)
+        sharded = _state(plan)
+        restored, _ = ckpt.restore(sharded)
+    leaf = jax.tree.leaves(restored.params)[0]
+    tmpl = jax.tree.leaves(sharded.params)[0]
+    assert leaf.sharding == tmpl.sharding
+    np.testing.assert_array_equal(
+        np.asarray(leaf), np.asarray(jax.tree.leaves(state.params)[0])
+    )
+
+
+def test_retention_and_best(tmp_path):
+    state = _state()
+    losses = [3.0, 1.0, 2.0, 0.5, 4.0, 5.0]
+    with Checkpointer(
+        tmp_path / "ckpt", max_to_keep=3, best_metric="loss", best_mode="min"
+    ) as ckpt:
+        for i, loss in enumerate(losses):
+            ckpt.save(state, step=i, metrics={"loss": loss})
+        ckpt.wait()
+        assert ckpt.best_step() == 3
+        assert best_checkpoint_path(ckpt).endswith("3")
+        kept = ckpt.all_steps()
+        assert 3 in kept and len(kept) <= 4  # best survives pruning
+        assert ckpt.metrics_for(3) == {"loss": 0.5}
+
+
+def test_save_pytree_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((3,))}
+    path = save_pytree(tmp_path / "m" / "state.msgpack", tree)
+    out = load_pytree(path, jax.tree.map(jnp.zeros_like, tree))
+    np.testing.assert_array_equal(out["w"], np.arange(6.0).reshape(2, 3))
+    np.testing.assert_array_equal(out["b"], np.ones((3,)))

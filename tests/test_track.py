@@ -1,0 +1,99 @@
+"""MLflow-compatible tracking: file-store layout, metric history, artifacts,
+model logging, logger plugin, run-id broadcast (single-process degenerate)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from tpuframe.track import (
+    ExperimentTracker,
+    MLflowLogger,
+    SystemMetricsMonitor,
+    broadcast_run_id,
+)
+
+
+def test_experiment_get_or_create(tmp_path):
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    eid = tracker.set_experiment("/Users/me/experiments/cifar")
+    assert tracker.set_experiment("/Users/me/experiments/cifar") == eid
+    assert tracker.set_experiment("other") != eid
+    meta = yaml.safe_load((tmp_path / "mlruns" / eid / "meta.yaml").read_text())
+    assert meta["name"] == "/Users/me/experiments/cifar"
+    assert meta["lifecycle_stage"] == "active"
+
+
+def test_run_params_metrics_layout(tmp_path):
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    tracker.set_experiment("exp")
+    with tracker.start_run(run_name="baseline") as run:
+        run.log_params({"lr": 1e-3, "batch_size": 128})
+        for epoch, loss in enumerate([0.9, 0.5, 0.3]):
+            run.log_metric("train_loss", loss, step=epoch)
+
+    assert run.get_param("lr") == "0.001"
+    hist = run.get_metric_history("train_loss")
+    assert [(v, s) for _, v, s in hist] == [(0.9, 0), (0.5, 1), (0.3, 2)]
+    # mlflow file-store layout: metrics/<key> lines "<ts> <val> <step>"
+    run_dir = tmp_path / "mlruns" / tracker.experiment_id / run.run_id
+    assert (run_dir / "params" / "lr").read_text() == "0.001"
+    assert len((run_dir / "metrics" / "train_loss").read_text().splitlines()) == 3
+    meta = yaml.safe_load((run_dir / "meta.yaml").read_text())
+    assert meta["status"] == "FINISHED" and meta["end_time"] is not None
+    assert tracker.runs() == [run.run_id]
+
+
+def test_artifacts_and_model(tmp_path):
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    tracker.set_experiment("exp")
+    run = tracker.start_run()
+    src = tmp_path / "note.txt"
+    src.write_text("hello")
+    dest = run.log_artifact(str(src), "notes")
+    assert open(dest).read() == "hello"
+    run.log_dict({"epoch": 3, "acc": 0.9}, "meta/summary.json")
+    assert os.path.exists(run.artifact_path("meta", "summary.json"))
+
+    class FakeState:
+        params = {"w": jnp.ones((2, 2))}
+        batch_stats = {}
+
+    model_dir = run.log_model(FakeState(), "model")
+    mlmodel = yaml.safe_load(open(os.path.join(model_dir, "MLmodel")))
+    assert mlmodel["flavors"]["tpuframe"]["data"] == "model.msgpack"
+    assert os.path.exists(os.path.join(model_dir, "model.msgpack"))
+
+    from tpuframe.ckpt import load_pytree
+
+    out = load_pytree(
+        os.path.join(model_dir, "model.msgpack"),
+        {"params": {"w": jnp.zeros((2, 2))}, "batch_stats": {}},
+    )
+    np.testing.assert_array_equal(out["params"]["w"], np.ones((2, 2)))
+
+
+def test_mlflow_logger_plugin(tmp_path):
+    logger = MLflowLogger("exp", tracking_uri=str(tmp_path / "mlruns"))
+    logger.log_params({"optimizer": "adam"})
+    logger.log_metrics({"train_loss": 0.7}, step=0)
+    run = logger.run
+    logger.flush()
+    assert run.get_param("optimizer") == "adam"
+    assert run.get_metric_history("train_loss")[0][1:] == (0.7, 0)
+
+
+def test_broadcast_run_id_single_process():
+    assert broadcast_run_id("abc123") == "abc123"
+
+
+def test_system_metrics_monitor(tmp_path):
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    tracker.set_experiment("exp")
+    run = tracker.start_run()
+    mon = SystemMetricsMonitor(run, interval_s=60.0)
+    mon.start()
+    mon.stop()  # final sample logs at least one point
+    hist = run.get_metric_history("system/memory_rss_mb")
+    assert len(hist) >= 1 and hist[0][1] > 0

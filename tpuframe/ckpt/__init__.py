@@ -1,0 +1,31 @@
+"""Checkpointing: sharded save/restore of TrainState + metadata.
+
+TPU-native replacement for the reference's three checkpoint styles
+(SURVEY.md §5 "Checkpoint / resume"):
+
+- raw per-epoch ``torch.save({'model','optimizer'})`` into timestamped dirs
+  (`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:109-124`)
+  -> :class:`Checkpointer` step directories (orbax, sharded, async-capable);
+- MLflow ``log_state_dict`` per epoch + best-model tracking
+  (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`)
+  -> ``best_metric``/``best_mode`` retention in :class:`Checkpointer`;
+- Ray's metrics-bundled ``Checkpoint.from_directory``
+  (`/root/reference/05_ray/01_fashion_mnist_pytorch_ray.ipynb:cell-6,cell-9`)
+  -> metrics/meta JSON saved inside every checkpoint step.
+"""
+
+from tpuframe.ckpt.checkpoint import (
+    Checkpointer,
+    best_checkpoint_path,
+    latest_step,
+    load_pytree,
+    save_pytree,
+)
+
+__all__ = [
+    "Checkpointer",
+    "best_checkpoint_path",
+    "latest_step",
+    "load_pytree",
+    "save_pytree",
+]

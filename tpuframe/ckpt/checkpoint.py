@@ -1,0 +1,209 @@
+"""Orbax-backed checkpointer for TrainState pytrees.
+
+Serializes only the *data* half of a TrainState (step/params/opt_state/
+batch_stats/rng); the static half (apply_fn, tx) is re-supplied by the live
+state at restore time, so a checkpoint is pure arrays + JSON and restores
+directly onto whatever mesh/sharding the restoring process is running —
+resharding across different device counts is free (orbax reads each shard of
+the target sharding from disk).
+
+Replaces the reference's ``torch.save``/``load_checkpoint(epoch)`` pair
+(`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:109-124`)
+and its DDP ``.module.state_dict()`` unwrap (`:239-245`) — there is no wrapper
+to unwrap here, TrainState is already the canonical pytree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tpuframe.core import runtime as rt
+
+_DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
+
+
+def _state_data(state: Any) -> dict:
+    """The serializable pytree of a TrainState (or pass dicts through)."""
+    if isinstance(state, Mapping):
+        return dict(state)
+    return {f: getattr(state, f) for f in _DATA_FIELDS}
+
+
+def latest_step(directory: str | os.PathLike) -> int | None:
+    """Highest numbered step dir under ``directory`` (None if empty/missing)."""
+    try:
+        entries = os.listdir(directory)
+    except FileNotFoundError:
+        return None
+    steps = [int(e) for e in entries if e.isdigit()]
+    return max(steps) if steps else None
+
+
+class Checkpointer:
+    """Per-step sharded checkpoints with retention + best tracking + resume.
+
+    Args:
+      directory: root dir; each save lands in ``<directory>/<step>/``.
+      max_to_keep: prune old steps beyond this count (best is never pruned).
+      best_metric: metric name (from the metrics dict passed to ``save``)
+        used for best-checkpoint tracking; None disables.
+      best_mode: "min" (loss-like) or "max" (accuracy-like).
+      async_save: overlap serialization with the next train steps (orbax
+        async); ``wait()``/``close()`` joins.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        max_to_keep: int | None = 5,
+        best_metric: str | None = None,
+        best_mode: str = "min",
+        async_save: bool = False,
+    ):
+        if best_mode not in ("min", "max"):
+            raise ValueError(f"best_mode must be 'min' or 'max', got {best_mode!r}")
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.max_to_keep = max_to_keep
+        self.best_metric = best_metric
+        self.best_mode = best_mode
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            best_fn=(lambda m: float(m.get(best_metric, np.inf if best_mode == "min" else -np.inf)))
+            if best_metric
+            else None,
+            best_mode=best_mode,
+            enable_async_checkpointing=async_save,
+            create=True,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    # -- save --------------------------------------------------------------
+    def save(
+        self,
+        state: Any,
+        *,
+        metrics: Mapping[str, float] | None = None,
+        meta: Mapping[str, Any] | None = None,
+        step: int | None = None,
+        force: bool = False,
+    ) -> str:
+        """Save state (+ metrics/meta JSON) at ``step`` (default: state.step).
+
+        Every process must call this (sharded leaves are written
+        cooperatively); returns the checkpoint directory path.
+        """
+        if step is None:
+            step = int(jax.device_get(getattr(state, "step", 0)))
+        metrics = {k: float(v) for k, v in (metrics or {}).items()}
+        meta = dict(meta or {})
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(_state_data(state)),
+                meta=ocp.args.JsonSave({"meta": meta, "metrics": metrics}),
+            ),
+            metrics=metrics or None,
+            force=force,
+        )
+        return os.path.join(self.directory, str(step))
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, state: Any, step: int | None = None) -> tuple[Any, dict]:
+        """Restore ``step`` (default latest) into the template ``state``.
+
+        The template supplies structure, dtypes and shardings — restored
+        arrays land directly on device with the template's placement.
+        Returns (new_state, meta_dict).
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        template = _state_data(state)
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        data, extra = restored["state"], restored.get("meta") or {}
+        if isinstance(state, Mapping):
+            return dict(data), dict(extra.get("meta", {}))
+        return state.replace(**data), dict(extra.get("meta", {}))
+
+    def maybe_restore(self, state: Any, step: int | None = None) -> tuple[Any, dict | None]:
+        """Restore if any checkpoint exists, else pass through (auto-resume)."""
+        if self._mgr.latest_step() is None:
+            return state, None
+        new_state, meta = self.restore(state, step)
+        return new_state, meta
+
+    # -- queries -----------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def best_step(self) -> int | None:
+        return self._mgr.best_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def metrics_for(self, step: int) -> dict:
+        """The metrics JSON bundled with ``step`` (Ray-style result reload)."""
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )
+        return dict((restored.get("meta") or {}).get("metrics", {}))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- single-file pytree helpers (the lightweight torch.save analogue) -------
+
+def save_pytree(path: str | os.PathLike, tree: Any) -> str:
+    """One-file msgpack save of a (host-gathered) pytree — the analogue of the
+    reference's ad-hoc ``torch.save(state_dict, path)`` for small artifacts.
+    Rank-0 discipline is the caller's job (or use under ``is_main_process``)."""
+    from flax import serialization
+
+    path = os.fspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(host_tree))
+    return path
+
+
+def load_pytree(path: str | os.PathLike, template: Any) -> Any:
+    """Inverse of :func:`save_pytree`; ``template`` gives the tree structure."""
+    from flax import serialization
+
+    with open(os.fspath(path), "rb") as f:
+        data = f.read()
+    host_template = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), template)
+    return serialization.from_bytes(host_template, data)
+
+
+def best_checkpoint_path(ckpt: Checkpointer) -> str | None:
+    """Path of the best checkpoint (None when best tracking is off/empty)."""
+    step = ckpt.best_step()
+    return None if step is None else os.path.join(ckpt.directory, str(step))

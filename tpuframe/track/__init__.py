@@ -1,0 +1,36 @@
+"""Experiment tracking: MLflow-compatible params/metrics/artifacts/models.
+
+TPU-native replacement for the reference's MLflow wiring (SURVEY.md §5
+"Metrics / logging"): experiment-per-notebook setup
+(`/root/reference/setup/00_setup.py:96-101`), per-epoch ``log_metric(step=)``
+(`/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:258-260`),
+param logging (`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:275-276`),
+state-dict/model artifacts (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`),
+system metrics (`02_cifar_torch_distributor_resnet.py:186`), and the rank-0 +
+run-id-broadcast discipline for multi-process logging (`cell-18`'s char-tensor
+hack becomes :func:`broadcast_run_id` on the control plane).
+
+Backend-neutral: writes the MLflow ``mlruns/`` file-store layout natively, so
+artifacts are readable by any MLflow UI/client; delegates to a real installed
+``mlflow`` package when one is importable and a tracking URI demands it.
+"""
+
+from tpuframe.track.mlflow_store import (
+    ExperimentTracker,
+    MLflowLogger,
+    Run,
+    broadcast_run_id,
+    set_experiment,
+    start_run,
+)
+from tpuframe.track.system_metrics import SystemMetricsMonitor
+
+__all__ = [
+    "ExperimentTracker",
+    "MLflowLogger",
+    "Run",
+    "broadcast_run_id",
+    "set_experiment",
+    "start_run",
+    "SystemMetricsMonitor",
+]

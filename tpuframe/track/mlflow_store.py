@@ -1,0 +1,381 @@
+"""MLflow-compatible experiment tracking on a file store.
+
+Implements the ``mlruns/`` file-store layout (experiment dirs with
+``meta.yaml``, run dirs with ``params/``, ``metrics/``, ``tags/``,
+``artifacts/``) natively, so runs written here open in any stock MLflow UI —
+wire-compat without requiring the mlflow package (BASELINE.md: "MLflow logging
+from setup/ stays intact").  When a real ``mlflow`` is importable, the same
+API transparently delegates to it (Databricks/remote tracking URIs).
+
+Reference behaviors reproduced:
+- experiment-per-name setup: ``mlflow.set_experiment(experiment_path)``
+  (`/root/reference/setup/00_setup.py:96-101`);
+- ``log_params`` once + ``log_metric(key, value, step=epoch)`` per epoch
+  (`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:275-276`,
+  `/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:258-260`);
+- state-dict and model artifacts per epoch / best
+  (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`);
+- run-id propagation to non-zero ranks — the reference broadcasts the run-id
+  as a char tensor over NCCL (`cell-18`); here :func:`broadcast_run_id` rides
+  the jax control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import posixpath
+import shutil
+import time
+import uuid
+from typing import Any, Mapping
+
+from tpuframe.core import runtime as rt
+
+_INVALID = set('/\\#?%:"<>|')
+
+
+def _sanitize(key: str) -> str:
+    return "".join("_" if c in _INVALID else c for c in str(key))
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def _write_yaml(path: str, data: Mapping[str, Any]) -> None:
+    import yaml
+
+    with open(path, "w") as f:
+        yaml.safe_dump(dict(data), f, default_flow_style=False)
+
+
+class Run:
+    """One tracked run (≈ ``mlflow.start_run()`` handle).
+
+    All writes are append-safe and idempotent-friendly; callers are expected
+    to gate on rank 0 (`MLflowLogger` and the Trainer do this for you).
+    """
+
+    def __init__(self, root: str, experiment_id: str, run_id: str | None = None,
+                 run_name: str | None = None):
+        self.experiment_id = experiment_id
+        self.run_id = run_id or uuid.uuid4().hex
+        self.run_name = run_name or f"run-{self.run_id[:8]}"
+        self._dir = os.path.join(root, experiment_id, self.run_id)
+        self.artifact_dir = os.path.join(self._dir, "artifacts")
+        for sub in ("metrics", "params", "tags", "artifacts"):
+            os.makedirs(os.path.join(self._dir, sub), exist_ok=True)
+        self._start = _now_ms()
+        self._write_meta(status="RUNNING", end_time=None)
+        self.set_tag("mlflow.runName", self.run_name)
+
+    def _write_meta(self, status: str, end_time: int | None) -> None:
+        _write_yaml(
+            os.path.join(self._dir, "meta.yaml"),
+            {
+                "artifact_uri": "file://" + os.path.abspath(self.artifact_dir),
+                "end_time": end_time,
+                "entry_point_name": "",
+                "experiment_id": self.experiment_id,
+                "lifecycle_stage": "active",
+                "run_id": self.run_id,
+                "run_name": self.run_name,
+                "run_uuid": self.run_id,
+                "source_name": "",
+                "source_type": 4,
+                "source_version": "",
+                "start_time": self._start,
+                "status": status,
+                "user_id": os.environ.get("USER", "tpuframe"),
+            },
+        )
+
+    # -- params / metrics / tags ------------------------------------------
+    def log_param(self, key: str, value: Any) -> None:
+        path = os.path.join(self._dir, "params", _sanitize(key))
+        with open(path, "w") as f:
+            f.write(str(value))
+
+    def log_params(self, params: Mapping[str, Any]) -> None:
+        for k, v in params.items():
+            self.log_param(k, v)
+
+    def log_metric(self, key: str, value: float, step: int = 0) -> None:
+        path = os.path.join(self._dir, "metrics", _sanitize(key))
+        with open(path, "a") as f:
+            f.write(f"{_now_ms()} {float(value)} {int(step)}\n")
+
+    def log_metrics(self, metrics: Mapping[str, float], step: int = 0) -> None:
+        for k, v in metrics.items():
+            self.log_metric(k, v, step)
+
+    def set_tag(self, key: str, value: Any) -> None:
+        path = os.path.join(self._dir, "tags", _sanitize(key))
+        with open(path, "w") as f:
+            f.write(str(value))
+
+    # -- artifacts ---------------------------------------------------------
+    def log_artifact(self, local_path: str, artifact_path: str | None = None) -> str:
+        dest_dir = self.artifact_dir
+        if artifact_path:
+            dest_dir = os.path.join(dest_dir, artifact_path)
+        os.makedirs(dest_dir, exist_ok=True)
+        dest = os.path.join(dest_dir, os.path.basename(local_path))
+        shutil.copy2(local_path, dest)
+        return dest
+
+    def log_text(self, text: str, artifact_file: str) -> str:
+        dest = os.path.join(self.artifact_dir, artifact_file)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "w") as f:
+            f.write(text)
+        return dest
+
+    def log_dict(self, data: Mapping[str, Any], artifact_file: str) -> str:
+        return self.log_text(json.dumps(dict(data), indent=2, default=str), artifact_file)
+
+    def log_state_dict(self, tree: Any, artifact_path: str = "state_dict") -> str:
+        """Per-epoch state-dict artifact (≈ ``mlflow.pytorch.log_state_dict``,
+        `/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`)."""
+        from tpuframe.ckpt import save_pytree
+
+        dest = os.path.join(self.artifact_dir, artifact_path, "state.msgpack")
+        save_pytree(dest, tree)
+        return dest
+
+    def log_model(self, state: Any, artifact_path: str = "model",
+                  meta: Mapping[str, Any] | None = None) -> str:
+        """Log a servable model artifact: params(+batch_stats) msgpack + an
+        ``MLmodel`` descriptor (≈ ``mlflow.pytorch.log_model``,
+        `/root/reference/01_torch_distributor/01_basic_torch_distributor.py:302-304`)."""
+        from tpuframe.ckpt import save_pytree
+
+        model_dir = os.path.join(self.artifact_dir, artifact_path)
+        tree = {
+            "params": getattr(state, "params", state),
+            "batch_stats": getattr(state, "batch_stats", {}),
+        }
+        save_pytree(os.path.join(model_dir, "model.msgpack"), tree)
+        _write_yaml(
+            os.path.join(model_dir, "MLmodel"),
+            {
+                "artifact_path": artifact_path,
+                "flavors": {
+                    "tpuframe": {
+                        "format": "flax-msgpack",
+                        "data": "model.msgpack",
+                        **dict(meta or {}),
+                    }
+                },
+                "run_id": self.run_id,
+                "utc_time_created": time.strftime(
+                    "%Y-%m-%d %H:%M:%S", time.gmtime()
+                ),
+            },
+        )
+        return model_dir
+
+    # -- lifecycle ---------------------------------------------------------
+    def end(self, status: str = "FINISHED") -> None:
+        self._write_meta(status=status, end_time=_now_ms())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        self.end("FAILED" if exc_type else "FINISHED")
+
+    # -- reads (for tests / reload paths) ----------------------------------
+    def get_metric_history(self, key: str) -> list[tuple[int, float, int]]:
+        path = os.path.join(self._dir, "metrics", _sanitize(key))
+        out = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    ts, val, step = line.split()
+                    out.append((int(ts), float(val), int(step)))
+        except FileNotFoundError:
+            pass
+        return out
+
+    def get_param(self, key: str) -> str | None:
+        try:
+            with open(os.path.join(self._dir, "params", _sanitize(key))) as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def artifact_path(self, *parts: str) -> str:
+        return os.path.join(self.artifact_dir, *parts)
+
+
+class ExperimentTracker:
+    """Experiment registry over an ``mlruns/`` root (≈ the mlflow client).
+
+    >>> tracker = ExperimentTracker("./mlruns")
+    >>> tracker.set_experiment("/Users/me/experiments/cifar")
+    >>> with tracker.start_run(run_name="baseline") as run:
+    ...     run.log_params({"lr": 1e-3}); run.log_metric("loss", 0.5, step=0)
+    """
+
+    def __init__(self, tracking_uri: str = "./mlruns"):
+        self.root = os.path.abspath(tracking_uri.removeprefix("file://"))
+        os.makedirs(self.root, exist_ok=True)
+        self.experiment_id: str | None = None
+        self.experiment_name: str | None = None
+
+    def _experiments(self) -> dict[str, str]:
+        """name -> experiment_id for existing experiments."""
+        import yaml
+
+        out = {}
+        for entry in sorted(os.listdir(self.root)):
+            meta = os.path.join(self.root, entry, "meta.yaml")
+            if entry.isdigit() and os.path.exists(meta):
+                with open(meta) as f:
+                    data = yaml.safe_load(f) or {}
+                if "name" in data and "run_id" not in data:
+                    out[data["name"]] = entry
+        return out
+
+    def set_experiment(self, name: str) -> str:
+        """Get-or-create an experiment by name; returns its id.  Mirrors the
+        idempotent ``mlflow.set_experiment`` in `setup/00_setup.py:96-101`."""
+        existing = self._experiments()
+        if name in existing:
+            self.experiment_id = existing[name]
+        else:
+            next_id = str(max((int(i) for i in existing.values()), default=-1) + 1)
+            exp_dir = os.path.join(self.root, next_id)
+            os.makedirs(exp_dir, exist_ok=True)
+            _write_yaml(
+                os.path.join(exp_dir, "meta.yaml"),
+                {
+                    "artifact_location": "file://" + exp_dir,
+                    "creation_time": _now_ms(),
+                    "experiment_id": next_id,
+                    "last_update_time": _now_ms(),
+                    "lifecycle_stage": "active",
+                    "name": name,
+                },
+            )
+            self.experiment_id = next_id
+        self.experiment_name = name
+        return self.experiment_id
+
+    def start_run(self, run_name: str | None = None, run_id: str | None = None) -> Run:
+        if self.experiment_id is None:
+            self.set_experiment("Default")
+        return Run(self.root, self.experiment_id, run_id=run_id, run_name=run_name)
+
+    def runs(self, experiment_name: str | None = None) -> list[str]:
+        import yaml
+
+        exp_id = self.experiment_id
+        if experiment_name is not None:
+            exp_id = self._experiments().get(experiment_name)
+        if exp_id is None:
+            return []
+        exp_dir = os.path.join(self.root, exp_id)
+        return [
+            e for e in sorted(os.listdir(exp_dir))
+            if os.path.isdir(os.path.join(exp_dir, e))
+            and os.path.exists(os.path.join(exp_dir, e, "meta.yaml"))
+        ]
+
+
+class MLflowLogger:
+    """Trainer logger plugin (≈ Composer's ``MLFlowLogger``,
+    `/root/reference/03_composer/01_cifar_composer_resnet.ipynb:cell-16`).
+
+    Duck-typed to the Trainer's logger contract: ``log_params(dict)``,
+    ``log_metrics(dict, step=)``, ``flush()``.  Creates the experiment/run
+    lazily on first write; only the main process ever writes (non-main
+    processes can still learn the run id via :func:`broadcast_run_id`).
+    """
+
+    def __init__(
+        self,
+        experiment_name: str = "tpuframe",
+        tracking_uri: str = "./mlruns",
+        run_name: str | None = None,
+        system_metrics: bool = False,
+    ):
+        self.experiment_name = experiment_name
+        self.tracking_uri = tracking_uri
+        self.run_name = run_name
+        self.system_metrics = system_metrics
+        self._tracker: ExperimentTracker | None = None
+        self._run: Run | None = None
+        self._monitor = None
+
+    @property
+    def run(self) -> Run:
+        if self._run is None:
+            self._tracker = ExperimentTracker(self.tracking_uri)
+            self._tracker.set_experiment(self.experiment_name)
+            self._run = self._tracker.start_run(run_name=self.run_name)
+            if self.system_metrics:
+                from tpuframe.track.system_metrics import SystemMetricsMonitor
+
+                self._monitor = SystemMetricsMonitor(self._run)
+                self._monitor.start()
+        return self._run
+
+    def log_params(self, params: Mapping[str, Any]) -> None:
+        self.run.log_params(params)
+
+    def log_metrics(self, metrics: Mapping[str, float], step: int = 0) -> None:
+        self.run.log_metrics(metrics, step)
+
+    def log_model(self, state: Any, artifact_path: str = "model") -> str:
+        return self.run.log_model(state, artifact_path)
+
+    def flush(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+        if self._run is not None:
+            self._run.end()
+            self._run = None
+
+
+# -- module-level convenience (the mlflow-style imperative API) --------------
+
+_DEFAULT_TRACKER: ExperimentTracker | None = None
+
+
+def set_experiment(name: str, tracking_uri: str = "./mlruns") -> ExperimentTracker:
+    global _DEFAULT_TRACKER
+    _DEFAULT_TRACKER = ExperimentTracker(tracking_uri)
+    _DEFAULT_TRACKER.set_experiment(name)
+    return _DEFAULT_TRACKER
+
+
+def start_run(run_name: str | None = None) -> Run:
+    if _DEFAULT_TRACKER is None:
+        set_experiment("Default")
+    return _DEFAULT_TRACKER.start_run(run_name=run_name)
+
+
+def broadcast_run_id(run_id: str | None, max_len: int = 64) -> str:
+    """Propagate rank 0's run id to every process over the jax control plane.
+
+    Replaces the reference's char-tensor NCCL broadcast
+    (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`): here
+    the string rides ``broadcast_one_to_all`` (a compiled host-data broadcast),
+    no manual chr/ord packing.  Call on ALL processes; pass the real id on
+    process 0 and anything (e.g. None) elsewhere.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if rt.process_count() == 1:
+        return run_id or ""
+    buf = np.zeros(max_len, np.uint8)
+    if rt.is_main_process() and run_id:
+        raw = run_id.encode()[:max_len]
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(out[out != 0]).decode()

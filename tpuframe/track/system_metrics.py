@@ -1,0 +1,96 @@
+"""System-metrics monitor: host + device utilization sampled in background.
+
+Replaces the reference's ``MLFLOW_ENABLE_SYSTEM_METRICS_LOGGING=true`` env
+(`/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:186`)
+and its ``nvidia-smi`` notebook cells (SURVEY.md §5 "Tracing / profiling"):
+a daemon thread samples /proc (CPU, RSS) and jax device memory stats (TPU HBM
+in-use) and appends them to the run's metrics with a monotonically increasing
+step, no external agents.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+def _cpu_times() -> tuple[float, float]:
+    """(process_cpu_seconds, wall_seconds)."""
+    t = os.times()
+    return (t.user + t.system), time.monotonic()
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def device_memory_stats() -> dict[str, float]:
+    """Per-device HBM usage in MB (empty on backends without stats, e.g. CPU)."""
+    import jax
+
+    out: dict[str, float] = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            used = stats.get("bytes_in_use", 0) / 2**20
+            limit = stats.get("bytes_limit", 0) / 2**20
+            out[f"device{d.id}_mem_used_mb"] = used
+            if limit:
+                out[f"device{d.id}_mem_util"] = used / limit
+    return out
+
+
+class SystemMetricsMonitor:
+    """Daemon thread logging system metrics to a Run every ``interval_s``."""
+
+    def __init__(self, run, interval_s: float = 10.0, prefix: str = "system/"):
+        self.run = run
+        self.interval_s = interval_s
+        self.prefix = prefix
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._step = 0
+
+    def sample(self) -> dict[str, float]:
+        cpu, wall = _cpu_times()
+        if not hasattr(self, "_last"):
+            self._last = (cpu, wall)
+        dcpu = cpu - self._last[0]
+        dwall = max(wall - self._last[1], 1e-9)
+        self._last = (cpu, wall)
+        metrics = {
+            f"{self.prefix}cpu_utilization": min(dcpu / dwall, float(os.cpu_count() or 1)),
+            f"{self.prefix}memory_rss_mb": _rss_mb(),
+        }
+        for k, v in device_memory_stats().items():
+            metrics[f"{self.prefix}{k}"] = v
+        return metrics
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.run.log_metrics(self.sample(), step=self._step)
+            self._step += 1
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        # final sample so short runs record at least one point
+        self.run.log_metrics(self.sample(), step=self._step)
