@@ -1,0 +1,162 @@
+"""Launcher contract tests: env injection, result plumbing, failure
+surfacing, Ray-style TPUTrainer reports, restart loop."""
+
+import os
+
+import pytest
+
+from tpuframe.launch import (
+    Checkpoint,
+    Distributor,
+    DistributorError,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TPUTrainer,
+    ZeroDistributor,
+    get_context,
+    report,
+    run_with_restarts,
+)
+
+
+def _echo_env():
+    return {
+        "rank": os.environ["RANK"],
+        "world": os.environ["WORLD_SIZE"],
+        "master": os.environ["MASTER_ADDR"],
+        "coord": os.environ.get("TPUFRAME_COORDINATOR"),
+    }
+
+
+def test_distributor_env_contract_and_rank0_result():
+    out = Distributor(num_processes=2).run(_echo_env)
+    assert out == {
+        "rank": "0",
+        "world": "2",
+        "master": "127.0.0.1",
+        "coord": out["coord"],
+    }
+    assert out["coord"].startswith("127.0.0.1:")
+
+
+def test_distributor_single_process_no_coordinator():
+    out = Distributor(num_processes=1).run(_echo_env)
+    assert out["world"] == "1" and out["coord"] is None
+
+
+def test_distributor_closure_and_args():
+    factor = 7
+
+    def fn(a, b=1):
+        return (a + b) * factor
+
+    assert Distributor(num_processes=1).run(fn, 2, b=3) == 35
+
+
+def test_distributor_simulated_devices():
+    def fn():
+        import jax
+
+        return jax.device_count()
+
+    assert Distributor(num_processes=1, simulate_devices=4).run(fn) == 4
+
+
+def test_distributor_worker_exception_propagates():
+    def boom():
+        raise RuntimeError("worker exploded")
+
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        Distributor(num_processes=1).run(boom)
+
+
+def test_distributor_nonrank0_failure_surfaced():
+    def fail_on_rank1():
+        if os.environ["RANK"] == "1":
+            raise RuntimeError("rank1 died")
+        return "ok"
+
+    with pytest.raises((DistributorError, RuntimeError), match="rank1 died|rank 1"):
+        Distributor(num_processes=2).run(fail_on_rank1)
+
+
+def test_zero_distributor_injects_config():
+    from tpuframe.parallel import ZeroConfig
+
+    def fn(zero_config=None):
+        return zero_config.stage
+
+    cfg = ZeroConfig(stage=2)
+    assert ZeroDistributor(num_processes=1, zero_config=cfg).run(fn) == 2
+
+
+def test_tpu_trainer_reports_and_result(tmp_path):
+    def train_loop(config):
+        ckpt_dir = os.path.join(os.environ["TPUFRAME_RESULT_DIR"], "work")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        for epoch in range(int(config["epochs"])):
+            with open(os.path.join(ckpt_dir, "state.txt"), "w") as f:
+                f.write(f"epoch={epoch}")
+            report(
+                {"loss": 1.0 / (epoch + 1), "epoch": epoch},
+                checkpoint=Checkpoint.from_directory(ckpt_dir),
+            )
+        return "finished"
+
+    trainer = TPUTrainer(
+        train_loop,
+        train_loop_config={"epochs": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t1"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["epoch"] == 2 and result.metrics["loss"] == pytest.approx(1 / 3)
+    assert len(result.metrics_dataframe) == 3
+    with result.checkpoint.as_directory() as d:
+        assert open(os.path.join(d, "state.txt")).read() == "epoch=2"
+
+
+def test_tpu_trainer_surfaces_error(tmp_path):
+    def bad_loop():
+        report({"loss": 9.0})
+        raise RuntimeError("mid-train crash")
+
+    result = TPUTrainer(
+        bad_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t2"),
+    ).fit()
+    assert result.error is not None
+    assert result.metrics == {"loss": 9.0}  # reports before the crash survive
+
+
+def test_report_outside_trainer_is_noop():
+    report({"loss": 1.0})  # no TPUFRAME_RESULT_DIR -> silently skipped
+    assert get_context().get_world_size() >= 1
+
+
+def test_run_with_restarts_recovers():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "done"
+
+    assert run_with_restarts(flaky, max_restarts=3, backoff_s=0.0) == "done"
+    assert len(calls) == 3
+
+
+def test_run_with_restarts_fatal_not_retried():
+    calls = []
+
+    def buggy():
+        calls.append(1)
+        raise ValueError("a code bug")
+
+    with pytest.raises(ValueError):
+        run_with_restarts(buggy, max_restarts=5, backoff_s=0.0)
+    assert len(calls) == 1

@@ -3,6 +3,7 @@ model logging, logger plugin, run-id broadcast (single-process degenerate)."""
 
 import os
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import yaml
@@ -41,7 +42,7 @@ def test_run_params_metrics_layout(tmp_path):
     assert (run_dir / "params" / "lr").read_text() == "0.001"
     assert len((run_dir / "metrics" / "train_loss").read_text().splitlines()) == 3
     meta = yaml.safe_load((run_dir / "meta.yaml").read_text())
-    assert meta["status"] == "FINISHED" and meta["end_time"] is not None
+    assert meta["status"] == 3 and meta["end_time"] is not None  # RunStatus.FINISHED
     assert tracker.runs() == [run.run_id]
 
 
@@ -82,6 +83,21 @@ def test_mlflow_logger_plugin(tmp_path):
     logger.flush()
     assert run.get_param("optimizer") == "adam"
     assert run.get_metric_history("train_loss")[0][1:] == (0.7, 0)
+
+
+def test_run_failed_status_and_nested_keys(tmp_path):
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    tracker.set_experiment("exp")
+    with pytest.raises(RuntimeError):
+        with tracker.start_run() as run:
+            run.log_metric("system/cpu_utilization", 0.5, step=0)
+            raise RuntimeError("boom")
+    run_dir = tmp_path / "mlruns" / tracker.experiment_id / run.run_id
+    meta = yaml.safe_load((run_dir / "meta.yaml").read_text())
+    assert meta["status"] == 4  # RunStatus.FAILED
+    # slash keys become nested file-store dirs, and read back unchanged
+    assert (run_dir / "metrics" / "system" / "cpu_utilization").exists()
+    assert run.get_metric_history("system/cpu_utilization")[0][1:] == (0.5, 0)
 
 
 def test_broadcast_run_id_single_process():

@@ -23,8 +23,6 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from tpuframe.core import runtime as rt
-
 _DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
 
 
@@ -100,7 +98,7 @@ class Checkpointer:
         cooperatively); returns the checkpoint directory path.
         """
         if step is None:
-            step = int(jax.device_get(getattr(state, "step", 0)))
+            step = int(jax.device_get(_state_data(state).get("step", 0) or 0))
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         meta = dict(meta or {})
         self._mgr.save(

@@ -1,0 +1,54 @@
+"""Launch layer: Distributor ``.run()``, Ray-style TPUTrainer, restart loops.
+
+TPU-native replacement for the reference's L5 launchers (SURVEY.md §1):
+
+- ``TorchDistributor(num_processes, local_mode, use_gpu).run(fn, *args)``
+  (`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:360-367`)
+  -> :class:`Distributor` — spawns per-host worker processes, injects the
+  rendezvous env (``MASTER_ADDR``/``MASTER_PORT``/``RANK``/``WORLD_SIZE``,
+  same contract the reference reads at `:271-272`), ships the closure with
+  cloudpickle, returns rank 0's picklable result.
+- ``DeepspeedTorchDistributor(numGpus, nnodes, localMode, deepspeedConfig)``
+  (`/root/reference/02_deepspeed/01_cifar_deepspeed_resnet.py:102-109`)
+  -> :class:`ZeroDistributor` — same spawn path plus a ZeroConfig made
+  available to the train fn (the reference authored but never wired its
+  configs; here they are actually applied).
+- Ray Train's ``TorchTrainer(train_func, ScalingConfig, RunConfig)`` +
+  ``Result``/``report`` (`/root/reference/05_ray/
+  01_fashion_mnist_pytorch_ray.ipynb:cell-6..cell-10`)
+  -> :class:`TPUTrainer` with :func:`report` / :func:`get_context`.
+- Elastic recovery (absent in the reference, SURVEY.md §5) ->
+  :func:`run_with_restarts` checkpoint-resume restart loop.
+"""
+
+from tpuframe.launch.distributor import (
+    Distributor,
+    DistributorError,
+    ZeroDistributor,
+)
+from tpuframe.launch.elastic import run_with_restarts
+from tpuframe.launch.trainer_api import (
+    Checkpoint,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TPUTrainer,
+    TrainContext,
+    get_context,
+    report,
+)
+
+__all__ = [
+    "Distributor",
+    "DistributorError",
+    "ZeroDistributor",
+    "run_with_restarts",
+    "Checkpoint",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TPUTrainer",
+    "TrainContext",
+    "get_context",
+    "report",
+]

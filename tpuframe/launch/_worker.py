@@ -1,0 +1,46 @@
+"""Worker entry: ``python -m tpuframe.launch._worker payload.pkl result.pkl``.
+
+Loads the cloudpickled (fn, args, kwargs), runs it, and writes the outcome —
+value or exception — as a pickle for the driver.  Exceptions re-raise after
+being recorded so the exit code stays nonzero (the driver surfaces the
+stderr tail either way).
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+
+
+def main(payload_path: str, result_path: str) -> None:
+    import cloudpickle
+
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = cloudpickle.load(f)
+    try:
+        value = fn(*args, **kwargs)
+        outcome = {"ok": True, "value": value}
+    except BaseException as e:  # recorded, then re-raised
+        try:
+            pickle.dumps(e)
+            outcome = {"ok": False, "error": e}
+        except Exception:
+            outcome = {"ok": False, "error": RuntimeError(repr(e))}
+        _write(result_path, outcome)
+        raise
+    _write(result_path, outcome)
+
+
+def _write(path: str, outcome: dict) -> None:
+    try:
+        with open(path, "wb") as f:
+            pickle.dump(outcome, f)
+    except Exception as e:  # unpicklable return value
+        with open(path, "wb") as f:
+            pickle.dump(
+                {"ok": False, "error": RuntimeError(f"result not picklable: {e}")}, f
+            )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

@@ -1,0 +1,58 @@
+"""Elastic recovery: checkpoint-resume restart loop.
+
+The reference has no fault tolerance (SURVEY.md §5: "No elastic logic";
+Ray merely *surfaces* failures via ``result.error``).  tpuframe's model:
+training state lives in a :class:`tpuframe.ckpt.Checkpointer` with
+auto-resume (``maybe_restore``), so recovery = rerun the train fn and let it
+pick up the latest checkpoint.  :func:`run_with_restarts` drives that loop
+with bounded retries and failure classification.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+#: Exception types that are never worth retrying (bugs, not infra).
+_FATAL = (KeyboardInterrupt, SystemExit, TypeError, ValueError, AttributeError)
+
+
+def run_with_restarts(
+    fn: Callable[[], Any],
+    *,
+    max_restarts: int = 2,
+    backoff_s: float = 1.0,
+    retryable: Callable[[BaseException], bool] | None = None,
+    on_restart: Callable[[int, BaseException], None] | None = None,
+) -> Any:
+    """Run ``fn`` until success or retry budget exhaustion.
+
+    ``fn`` must be resumable — i.e. it restores from its checkpointer on
+    entry (the Trainer's ``maybe_restore`` does this) so a restart continues
+    rather than recomputes.  ``retryable`` classifies failures (default:
+    anything except obvious code bugs); ``on_restart(attempt, error)`` is the
+    observability hook (log, page, mark the run).
+    """
+
+    def default_retryable(e: BaseException) -> bool:
+        return not isinstance(e, _FATAL)
+
+    retryable = retryable or default_retryable
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as e:
+            if attempt >= max_restarts or not retryable(e):
+                raise
+            attempt += 1
+            logger.warning(
+                "train fn failed (%s); restart %d/%d after %.1fs",
+                repr(e), attempt, max_restarts, backoff_s,
+            )
+            if on_restart is not None:
+                on_restart(attempt, e)
+            time.sleep(backoff_s)

@@ -1,0 +1,211 @@
+"""Ray-Train-shaped API: TPUTrainer + ScalingConfig/RunConfig/Result/report.
+
+Capability parity with the reference's Ray family (SURVEY.md §3.5,
+`/root/reference/05_ray/01_fashion_mnist_pytorch_ray.ipynb`):
+
+- ``TorchTrainer(train_func, scaling_config=ScalingConfig(num_workers, use_gpu),
+  run_config=RunConfig(storage_path))`` (cell-7) -> :class:`TPUTrainer`;
+- ``ray.train.report(metrics, checkpoint=Checkpoint.from_directory(d))``
+  per epoch inside the worker (cell-6) -> :func:`report`;
+- ``ray.train.get_context().get_world_size()/get_world_rank()`` (cell-6)
+  -> :func:`get_context`;
+- ``result.metrics / result.checkpoint / result.path / result.error``
+  (cell-8) -> :class:`Result`;
+- checkpoint reload via ``result.checkpoint.as_directory()`` (cell-9)
+  -> :meth:`Checkpoint.as_directory`.
+
+Workers report through files under the run's storage path (the driver and
+workers are separate processes, same as Ray actors), so the last report per
+rank survives worker exit and the driver can reconstruct history.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Callable, Mapping
+
+from tpuframe.launch.distributor import Distributor, DistributorError
+
+_RESULT_DIR_ENV = "TPUFRAME_RESULT_DIR"
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """≈ ``ray.train.ScalingConfig(num_workers, use_gpu)`` (cell-7)."""
+
+    num_workers: int = 1
+    use_tpu: bool = True
+    simulate_devices: int | None = None
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """≈ ``ray.train.RunConfig(storage_path)`` (cell-7)."""
+
+    storage_path: str = "~/tpuframe_results"
+    name: str | None = None
+
+
+class Checkpoint:
+    """A directory-backed checkpoint bundle (≈ ``ray.train.Checkpoint``)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        """Yield a local directory with the checkpoint contents (cell-9)."""
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path!r})"
+
+
+@dataclasses.dataclass
+class Result:
+    """≈ ``trainer.fit()``'s result object (cell-8)."""
+
+    metrics: dict[str, float]
+    checkpoint: Checkpoint | None
+    path: str
+    error: BaseException | None
+    metrics_dataframe: list[dict] = dataclasses.field(default_factory=list)
+
+
+class TrainContext:
+    """World/rank/report plumbing visible inside a worker (cell-6)."""
+
+    def get_world_size(self) -> int:
+        return int(os.environ.get("WORLD_SIZE", "1"))
+
+    def get_world_rank(self) -> int:
+        return int(os.environ.get("RANK", "0"))
+
+    def get_local_rank(self) -> int:
+        return int(os.environ.get("LOCAL_RANK", "0"))
+
+    def get_result_dir(self) -> str | None:
+        return os.environ.get(_RESULT_DIR_ENV)
+
+
+def get_context() -> TrainContext:
+    return TrainContext()
+
+
+def report(metrics: Mapping[str, float], checkpoint: Checkpoint | None = None) -> None:
+    """Report metrics (+ optional checkpoint bundle) from a worker — the
+    Ray contract at cell-6.  Rank 0's reports become the driver's Result;
+    checkpoints are copied into the run storage so they outlive the worker's
+    temp dirs."""
+    ctx = get_context()
+    result_dir = ctx.get_result_dir()
+    if result_dir is None:
+        return  # running outside a TPUTrainer (e.g. unit test of the fn)
+    rank = ctx.get_world_rank()
+    record: dict[str, Any] = {
+        "time": time.time(),
+        "metrics": {k: float(v) for k, v in metrics.items()},
+        "checkpoint": None,
+    }
+    if checkpoint is not None and rank == 0:
+        seq = int(_read_seq(result_dir, rank)) + 1
+        dest = os.path.join(result_dir, f"checkpoint_{seq:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        record["checkpoint"] = dest
+    with open(os.path.join(result_dir, f"rank_{rank}.jsonl"), "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def _read_seq(result_dir: str, rank: int) -> int:
+    path = os.path.join(result_dir, f"rank_{rank}.jsonl")
+    try:
+        with open(path) as f:
+            return sum(1 for _ in f)
+    except FileNotFoundError:
+        return 0
+
+
+class TPUTrainer:
+    """Driver-side trainer handle (≈ ``ray.train.torch.TorchTrainer``).
+
+    >>> trainer = TPUTrainer(train_func,
+    ...                      train_loop_config={"lr": 1e-3},
+    ...                      scaling_config=ScalingConfig(num_workers=2),
+    ...                      run_config=RunConfig(storage_path="/tmp/runs"))
+    >>> result = trainer.fit()
+    >>> result.metrics, result.checkpoint, result.error
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Mapping[str, Any] | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.config = dict(train_loop_config or {})
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        """Spawn workers, run the loop, collect the Ray-shaped Result.
+
+        Worker failure lands in ``result.error`` (cell-8's ``result.error``
+        check), not as a driver exception."""
+        storage = os.path.expanduser(self.run_config.storage_path)
+        name = self.run_config.name or f"run_{time.strftime('%Y%m%d_%H%M%S')}"
+        result_dir = os.path.join(storage, name)
+        os.makedirs(result_dir, exist_ok=True)
+
+        dist = Distributor(
+            num_processes=self.scaling.num_workers,
+            simulate_devices=self.scaling.simulate_devices,
+            env={_RESULT_DIR_ENV: result_dir},
+        )
+        error: BaseException | None = None
+        try:
+            if self.config:
+                dist.run(self.train_loop, self.config)
+            else:
+                dist.run(self.train_loop)
+        except (DistributorError, Exception) as e:  # surface via Result
+            error = e
+
+        history = self._read_history(result_dir, rank=0)
+        metrics = history[-1]["metrics"] if history else {}
+        ckpt_path = next(
+            (r["checkpoint"] for r in reversed(history) if r.get("checkpoint")), None
+        )
+        return Result(
+            metrics=metrics,
+            checkpoint=Checkpoint(ckpt_path) if ckpt_path else None,
+            path=result_dir,
+            error=error,
+            metrics_dataframe=[r["metrics"] for r in history],
+        )
+
+    @staticmethod
+    def _read_history(result_dir: str, rank: int) -> list[dict]:
+        path = os.path.join(result_dir, f"rank_{rank}.jsonl")
+        out = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    if line.strip():
+                        out.append(json.loads(line))
+        except FileNotFoundError:
+            pass
+        return out

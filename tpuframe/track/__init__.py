@@ -11,8 +11,8 @@ run-id-broadcast discipline for multi-process logging (`cell-18`'s char-tensor
 hack becomes :func:`broadcast_run_id` on the control plane).
 
 Backend-neutral: writes the MLflow ``mlruns/`` file-store layout natively, so
-artifacts are readable by any MLflow UI/client; delegates to a real installed
-``mlflow`` package when one is importable and a tracking URI demands it.
+runs and artifacts are readable by any stock MLflow UI/client pointed at the
+same directory — no mlflow package required.
 """
 
 from tpuframe.track.mlflow_store import (

@@ -4,8 +4,9 @@ Implements the ``mlruns/`` file-store layout (experiment dirs with
 ``meta.yaml``, run dirs with ``params/``, ``metrics/``, ``tags/``,
 ``artifacts/``) natively, so runs written here open in any stock MLflow UI —
 wire-compat without requiring the mlflow package (BASELINE.md: "MLflow logging
-from setup/ stays intact").  When a real ``mlflow`` is importable, the same
-API transparently delegates to it (Databricks/remote tracking URIs).
+from setup/ stays intact").  Remote/Databricks tracking URIs are out of scope
+for the file store; point a stock mlflow client at the same ``mlruns/`` dir
+to sync runs wherever you like.
 
 Reference behaviors reproduced:
 - experiment-per-name setup: ``mlflow.set_experiment(experiment_path)``
@@ -24,7 +25,6 @@ from __future__ import annotations
 
 import json
 import os
-import posixpath
 import shutil
 import time
 import uuid
@@ -32,11 +32,25 @@ from typing import Any, Mapping
 
 from tpuframe.core import runtime as rt
 
-_INVALID = set('/\\#?%:"<>|')
+_INVALID = set('\\#?%:"<>|')
+
+#: MLflow's RunStatus int enum, as persisted by its file store.
+_STATUS = {"RUNNING": 1, "SCHEDULED": 2, "FINISHED": 3, "FAILED": 4, "KILLED": 5}
 
 
 def _sanitize(key: str) -> str:
-    return "".join("_" if c in _INVALID else c for c in str(key))
+    """Key -> relative path.  '/' is legal in MLflow keys and maps to nested
+    directories in the file store ('system/cpu' -> metrics/system/cpu);
+    path-escape segments are neutralized."""
+    cleaned = "".join("_" if c in _INVALID else c for c in str(key))
+    parts = [p for p in cleaned.split("/") if p not in ("", ".", "..")]
+    return "/".join(parts) or "_"
+
+
+def _key_file(base: str, key: str) -> str:
+    path = os.path.join(base, *(_sanitize(key).split("/")))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
 
 
 def _now_ms() -> int:
@@ -86,15 +100,14 @@ class Run:
                 "source_type": 4,
                 "source_version": "",
                 "start_time": self._start,
-                "status": status,
+                "status": _STATUS.get(status, status),
                 "user_id": os.environ.get("USER", "tpuframe"),
             },
         )
 
     # -- params / metrics / tags ------------------------------------------
     def log_param(self, key: str, value: Any) -> None:
-        path = os.path.join(self._dir, "params", _sanitize(key))
-        with open(path, "w") as f:
+        with open(_key_file(os.path.join(self._dir, "params"), key), "w") as f:
             f.write(str(value))
 
     def log_params(self, params: Mapping[str, Any]) -> None:
@@ -102,8 +115,7 @@ class Run:
             self.log_param(k, v)
 
     def log_metric(self, key: str, value: float, step: int = 0) -> None:
-        path = os.path.join(self._dir, "metrics", _sanitize(key))
-        with open(path, "a") as f:
+        with open(_key_file(os.path.join(self._dir, "metrics"), key), "a") as f:
             f.write(f"{_now_ms()} {float(value)} {int(step)}\n")
 
     def log_metrics(self, metrics: Mapping[str, float], step: int = 0) -> None:
@@ -111,8 +123,7 @@ class Run:
             self.log_metric(k, v, step)
 
     def set_tag(self, key: str, value: Any) -> None:
-        path = os.path.join(self._dir, "tags", _sanitize(key))
-        with open(path, "w") as f:
+        with open(_key_file(os.path.join(self._dir, "tags"), key), "w") as f:
             f.write(str(value))
 
     # -- artifacts ---------------------------------------------------------
@@ -188,7 +199,7 @@ class Run:
 
     # -- reads (for tests / reload paths) ----------------------------------
     def get_metric_history(self, key: str) -> list[tuple[int, float, int]]:
-        path = os.path.join(self._dir, "metrics", _sanitize(key))
+        path = os.path.join(self._dir, "metrics", *_sanitize(key).split("/"))
         out = []
         try:
             with open(path) as f:
@@ -201,7 +212,7 @@ class Run:
 
     def get_param(self, key: str) -> str | None:
         try:
-            with open(os.path.join(self._dir, "params", _sanitize(key))) as f:
+            with open(os.path.join(self._dir, "params", *_sanitize(key).split("/"))) as f:
                 return f.read()
         except FileNotFoundError:
             return None
@@ -332,13 +343,18 @@ class MLflowLogger:
     def log_model(self, state: Any, artifact_path: str = "model") -> str:
         return self.run.log_model(state, artifact_path)
 
-    def flush(self) -> None:
+    def flush(self, status: str = "FINISHED") -> None:
         if self._monitor is not None:
             self._monitor.stop()
             self._monitor = None
         if self._run is not None:
-            self._run.end()
+            self._run.end(status)
             self._run = None
+
+    def finish(self, error: BaseException | None = None) -> None:
+        """End the run with a truthful status — a crashed fit records FAILED
+        (the Trainer calls this from its finally block)."""
+        self.flush("FAILED" if error is not None else "FINISHED")
 
 
 # -- module-level convenience (the mlflow-style imperative API) --------------

@@ -61,6 +61,7 @@ class SystemMetricsMonitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._step = 0
+        self._lock = threading.Lock()  # serializes thread vs stop() final sample
 
     def sample(self) -> dict[str, float]:
         cpu, wall = _cpu_times()
@@ -79,8 +80,9 @@ class SystemMetricsMonitor:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            self.run.log_metrics(self.sample(), step=self._step)
-            self._step += 1
+            with self._lock:
+                self.run.log_metrics(self.sample(), step=self._step)
+                self._step += 1
 
     def start(self) -> None:
         if self._thread is None:
@@ -93,4 +95,6 @@ class SystemMetricsMonitor:
             self._thread.join(timeout=2.0)
             self._thread = None
         # final sample so short runs record at least one point
-        self.run.log_metrics(self.sample(), step=self._step)
+        with self._lock:
+            self.run.log_metrics(self.sample(), step=self._step)
+            self._step += 1

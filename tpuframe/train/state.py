@@ -47,9 +47,15 @@ class TrainState(flax.struct.PyTreeNode):
         )
 
     def step_rng(self, name: str = "dropout") -> jax.Array:
-        """Per-step, per-collection RNG derived from the state's base key."""
+        """Per-step, per-collection RNG derived from the state's base key.
+
+        crc32, not ``hash()``: PYTHONHASHSEED randomizes ``hash`` per process,
+        which would bake different fold-in constants into each host's compiled
+        step and desynchronize nominally-replicated computation."""
+        import zlib
+
         key = jax.random.fold_in(self.rng, self.step)
-        return jax.random.fold_in(key, hash(name) % (2**31))
+        return jax.random.fold_in(key, zlib.crc32(name.encode()) % (2**31))
 
 
 def create_train_state(
@@ -79,6 +85,7 @@ def create_train_state(
         batch_stats = variables.get("batch_stats", {})
         return params, batch_stats, tx.init(params)
 
+    step = jnp.zeros((), jnp.int32)
     if plan is None:
         params, batch_stats, opt_state = init_fn()
     else:
@@ -89,9 +96,15 @@ def create_train_state(
             plan.state_shardings(a_opt, a_params),
         )
         params, batch_stats, opt_state = jax.jit(init_fn, out_shardings=shardings)()
+        # Scalars must be *committed replicated* on the same mesh as the
+        # params: a checkpoint restore reproduces the template's placement,
+        # and a single-device committed step next to mesh-wide params is a
+        # jit device mismatch.
+        step = jax.device_put(step, plan.replicated())
+        state_rng = jax.device_put(state_rng, plan.replicated())
 
     return TrainState(
-        step=jnp.zeros((), jnp.int32),
+        step=step,
         params=params,
         opt_state=opt_state,
         batch_stats=batch_stats,

@@ -286,7 +286,11 @@ class Trainer:
             result.stopped_reason = self._stop_reason
             self._emit("on_fit_end")
             for lg in self.loggers:
-                if hasattr(lg, "flush"):
+                # finish(error=) lets status-aware loggers record FAILED for a
+                # crashed fit instead of a blanket flush-as-success.
+                if hasattr(lg, "finish"):
+                    lg.finish(error=result.error)
+                elif hasattr(lg, "flush"):
                     lg.flush()
         return result
 
