@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet50 ImageNet-shape train-step throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+compares against an estimate of the reference hardware's capability:
+~400 images/sec for ResNet50 mixed-precision training on one A10G (the
+per-GPU rate the reference's 4xA10G DDP examples would sustain).
+
+On TPU: bf16 compute, 224px ImageNet shapes, donated jitted step.
+On CPU (smoke): tiny shapes so the script stays runnable anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# Reference-hardware estimate (A10G, ResNet50, mixed precision), img/s/GPU.
+BASELINE_IMG_PER_SEC = 400.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.models import ResNet50
+    from tpuframe.parallel import ParallelPlan, bf16_compute, full_precision
+    from tpuframe.train import create_train_state, make_train_step
+
+    on_accel = jax.default_backend() != "cpu"
+    chips = max(jax.local_device_count(), 1)
+    batch = 128 * chips if on_accel else 8
+    size = 224 if on_accel else 32
+    steps = 30 if on_accel else 3
+
+    # Data-parallel over every local device so the per-chip division below
+    # reflects work actually placed on each chip.
+    plan = ParallelPlan(mesh=MeshSpec(data=-1).build())
+
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = create_train_state(
+        model,
+        jax.random.PRNGKey(0),
+        jnp.ones((1, size, size, 3), jnp.float32),
+        tx,
+        plan=plan,
+        init_kwargs={"train": False},
+    )
+    policy = bf16_compute() if on_accel else full_precision()
+    step_fn = make_train_step(policy)
+
+    rng = np.random.default_rng(0)
+    data = plan.shard_batch(
+        {
+            "image": rng.standard_normal((batch, size, size, 3)).astype(np.float32),
+            "label": rng.integers(0, 1000, (batch,)).astype(np.int32),
+        }
+    )
+
+    # Compile + warmup (first step compiles, second settles caches).
+    for _ in range(2):
+        state, metrics = step_fn(state, data)
+    jax.block_until_ready((state, metrics))
+
+    # Median-of-rounds with a joint block on the full output pytree each
+    # round: guards against async-dispatch/tunnel artifacts where blocking
+    # on one small output under-reports wall time.
+    rates = []
+    for _ in range(3):
+        step_before = int(state.step)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, data)
+        jax.block_until_ready((state, metrics))
+        elapsed = time.perf_counter() - t0
+        assert int(state.step) == step_before + steps
+        rates.append(batch * steps / elapsed)
+    assert np.isfinite(float(metrics["loss_sum"]))
+
+    chips = max(jax.local_device_count(), 1)
+    value = sorted(rates)[len(rates) // 2] / chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": f"images/sec/chip (batch={batch}, {size}px, "
+                f"{'bf16' if on_accel else 'fp32'}, {jax.default_backend()})",
+                "vs_baseline": round(value / BASELINE_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
