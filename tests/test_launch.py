@@ -160,3 +160,116 @@ def test_run_with_restarts_fatal_not_retried():
     with pytest.raises(ValueError):
         run_with_restarts(buggy, max_restarts=5, backoff_s=0.0)
     assert len(calls) == 1
+
+
+def test_distributor_preserves_exception_type():
+    def boom():
+        raise ValueError("typed failure")
+
+    with pytest.raises(ValueError, match="typed failure") as exc_info:
+        Distributor(num_processes=1).run(boom)
+    # stderr tail rides along as the cause
+    assert isinstance(exc_info.value.__cause__, DistributorError)
+
+
+def test_distributor_run_wide_timeout():
+    import time
+
+    def hang():
+        time.sleep(60)
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        Distributor(num_processes=2, timeout_s=3.0).run(hang)
+    # run-wide cap: 2 hung workers must not serialize into 2 x timeout_s
+    assert time.monotonic() - t0 < 30
+
+
+def test_tpu_trainer_empty_config_still_passed(tmp_path):
+    def loop(config):
+        report({"n_keys": len(config)})
+        return "ok"
+
+    result = TPUTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="empty_cfg"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics == {"n_keys": 0.0}
+
+
+def test_tpu_trainer_refit_same_name_fresh_history(tmp_path):
+    def loop(config):
+        for i in range(int(config["epochs"])):
+            report({"epoch": i})
+
+    def fit(epochs):
+        return TPUTrainer(
+            loop,
+            train_loop_config={"epochs": epochs},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=str(tmp_path), name="same"),
+        ).fit()
+
+    assert len(fit(3).metrics_dataframe) == 3
+    second = fit(2)
+    # second fit must not merge the first run's 3 reports into its history
+    assert len(second.metrics_dataframe) == 2
+
+
+def test_distributor_timeout_surfaces_crashed_peer():
+    import time
+
+    def crash_or_hang():
+        if os.environ["RANK"] == "0":
+            raise ValueError("root cause")
+        time.sleep(60)
+
+    # rank 0 dies, rank 1 hangs: the crash, not the timeout, must surface.
+    # simulate_devices strips the image's jax-preloading sitecustomize
+    # trigger so worker startup fits well inside the deadline.
+    with pytest.raises(ValueError, match="root cause"):
+        Distributor(num_processes=2, timeout_s=15.0, simulate_devices=1).run(
+            crash_or_hang
+        )
+
+
+def test_tpu_trainer_sysexit_lands_in_result(tmp_path):
+    def exiting_loop():
+        report({"loss": 1.0})
+        raise SystemExit(3)
+
+    result = TPUTrainer(
+        exiting_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="se"),
+    ).fit()
+    assert result.error is not None  # not a driver exception
+    assert result.metrics == {"loss": 1.0}
+
+
+def test_tpu_trainer_refit_clears_stale_checkpoints(tmp_path):
+    def loop(config):
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, config["fname"]), "w") as f:
+            f.write("x")
+        report({"ok": 1.0}, checkpoint=Checkpoint.from_directory(d))
+
+    def fit(fname):
+        return TPUTrainer(
+            loop,
+            train_loop_config={"fname": fname},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=str(tmp_path), name="ck"),
+        ).fit()
+
+    fit("old_shard")
+    second = fit("new_shard")
+    with second.checkpoint.as_directory() as d:
+        files = set(os.listdir(d))
+    # run 1's shard must not bleed into run 2's checkpoint bundle
+    assert "new_shard" in files and "old_shard" not in files

@@ -113,3 +113,19 @@ def test_system_metrics_monitor(tmp_path):
     mon.stop()  # final sample logs at least one point
     hist = run.get_metric_history("system/memory_rss_mb")
     assert len(hist) >= 1 and hist[0][1] > 0
+
+
+def test_metric_key_prefix_collision_both_orders(tmp_path):
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    tracker.set_experiment("exp")
+    with tracker.start_run() as run:
+        # flat first, then nested under the same prefix (SystemMetricsMonitor
+        # key shapes) -- and the reverse -- must both survive and read back.
+        run.log_metric("system", 1.0, step=0)
+        run.log_metric("system/cpu", 2.0, step=1)
+        run.log_metric("nested/deep", 3.0, step=0)
+        run.log_metric("nested", 4.0, step=1)
+    assert run.get_metric_history("system")[0][1:] == (1.0, 0)
+    assert run.get_metric_history("system/cpu")[0][1:] == (2.0, 1)
+    assert run.get_metric_history("nested/deep")[0][1:] == (3.0, 0)
+    assert run.get_metric_history("nested")[0][1:] == (4.0, 1)

@@ -22,7 +22,7 @@ def main(payload_path: str, result_path: str) -> None:
         outcome = {"ok": True, "value": value}
     except BaseException as e:  # recorded, then re-raised
         try:
-            pickle.dumps(e)
+            cloudpickle.dumps(e)
             outcome = {"ok": False, "error": e}
         except Exception:
             outcome = {"ok": False, "error": RuntimeError(repr(e))}
@@ -32,9 +32,13 @@ def main(payload_path: str, result_path: str) -> None:
 
 
 def _write(path: str, outcome: dict) -> None:
+    # cloudpickle, matching the payload: values/exceptions of classes the
+    # user defined in __main__ (notebooks) ship by value, not by reference.
+    import cloudpickle
+
     try:
         with open(path, "wb") as f:
-            pickle.dump(outcome, f)
+            cloudpickle.dump(outcome, f)
     except Exception as e:  # unpicklable return value
         with open(path, "wb") as f:
             pickle.dump(

@@ -31,15 +31,26 @@ import pickle
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Any, Callable, Mapping, Sequence
 
 import cloudpickle
 
 _STDERR_TAIL = 4000
 
+#: The exit code our own kill() produces (SIGKILL), vs. workload crashes.
+_KILL_CODES = (-9,)
+
+#: Once one worker has failed, hung peers get this long to exit on their
+#: own before the driver kills them — not the full run deadline.
+_FAILURE_GRACE_S = 5.0
+
 
 class DistributorError(RuntimeError):
-    """A worker exited nonzero; carries rank and stderr tail."""
+    """A worker exited nonzero without a recoverable typed exception;
+    carries rank and stderr tail.  When the worker *did* record its
+    exception, ``run`` re-raises that original exception instead, with a
+    DistributorError as its ``__cause__``."""
 
     def __init__(self, rank: int, returncode: int, stderr_tail: str):
         self.rank = rank
@@ -153,40 +164,114 @@ class Distributor:
                 cloudpickle.dump((fn, args, kwargs), f)
 
             procs: list[tuple[int, subprocess.Popen, str]] = []
-            for rank in range(self.num_processes):
-                result_path = os.path.join(tmp, f"result_{rank}.pkl")
-                stderr_path = os.path.join(tmp, f"stderr_{rank}.log")
-                p = subprocess.Popen(
-                    [sys.executable, "-m", "tpuframe.launch._worker",
-                     payload, result_path],
-                    env=self._worker_env(rank, port),
-                    stderr=open(stderr_path, "wb"),
-                    stdout=None if rank == 0 else subprocess.DEVNULL,
-                )
-                procs.append((rank, p, stderr_path))
+            stderr_files = []
+            deadline = time.monotonic() + self.timeout_s
+            try:
+                for rank in range(self.num_processes):
+                    result_path = os.path.join(tmp, f"result_{rank}.pkl")
+                    stderr_path = os.path.join(tmp, f"stderr_{rank}.log")
+                    stderr_f = open(stderr_path, "wb")
+                    stderr_files.append(stderr_f)
+                    p = subprocess.Popen(
+                        [sys.executable, "-m", "tpuframe.launch._worker",
+                         payload, result_path],
+                        env=self._worker_env(rank, port),
+                        stderr=stderr_f,
+                        stdout=None if rank == 0 else subprocess.DEVNULL,
+                    )
+                    procs.append((rank, p, stderr_path))
 
-            failure: DistributorError | None = None
-            for rank, p, stderr_path in procs:
-                try:
-                    code = p.wait(timeout=self.timeout_s)
-                except subprocess.TimeoutExpired:
-                    for _, q, _ in procs:
-                        q.kill()
-                    raise TimeoutError(
-                        f"worker rank {rank} exceeded {self.timeout_s}s"
-                    ) from None
-                if code != 0 and failure is None:
-                    with open(stderr_path, "rb") as f:
-                        tail = f.read()[-_STDERR_TAIL:].decode(errors="replace")
-                    failure = DistributorError(rank, code, tail)
-            if failure is not None:
-                raise failure
+                failure: BaseException | None = None
+                timed_out_rank: int | None = None
+                for rank, p, stderr_path in procs:
+                    # timeout_s is a run-wide wall-clock cap, so each wait
+                    # gets only what remains of the shared deadline — and
+                    # once a failure is in hand, peers hung at a collective
+                    # get only a short grace, not the rest of the deadline.
+                    remaining = deadline - time.monotonic()
+                    if failure is not None:
+                        remaining = min(remaining, _FAILURE_GRACE_S)
+                    try:
+                        code = p.wait(timeout=max(remaining, 0.1))
+                    except subprocess.TimeoutExpired:
+                        timed_out_rank = rank
+                        break
+                    if code != 0 and failure is None:
+                        failure = self._worker_failure(rank, code, stderr_path, tmp)
+                if timed_out_rank is not None:
+                    self._kill_and_reap(procs)
+                    if failure is None:
+                        # The usual distributed-crash shape: one rank died,
+                        # peers hung at the collective until the deadline.
+                        # The dead rank, not the timeout, is the root cause.
+                        for rank, p, stderr_path in procs:
+                            code = p.returncode
+                            if code in (None, 0) or code in _KILL_CODES:
+                                continue
+                            failure = self._worker_failure(rank, code, stderr_path, tmp)
+                            break
+                    if failure is None:
+                        raise TimeoutError(
+                            f"run exceeded {self.timeout_s}s "
+                            f"(worker rank {timed_out_rank} still running)"
+                        ) from None
+                if failure is not None:
+                    raise failure
+            finally:
+                # Every exit path — success, failure, spawn error, ctrl-C —
+                # must leave no live or zombie workers behind (a survivor
+                # would sit at rendezvous holding the host's chips, and the
+                # tempdir cleanup below would race its writes).
+                self._kill_and_reap(procs)
+                for f in stderr_files:
+                    f.close()
 
             with open(os.path.join(tmp, "result_0.pkl"), "rb") as f:
                 outcome = pickle.load(f)
         if outcome["ok"]:
             return outcome["value"]
         raise outcome["error"]
+
+    @staticmethod
+    def _kill_and_reap(procs: Sequence[tuple[int, subprocess.Popen, str]]) -> None:
+        for _, q, _ in procs:
+            if q.poll() is None:
+                q.kill()
+        for _, q, _ in procs:
+            try:
+                q.wait(timeout=10)
+            except Exception:
+                pass
+
+    def _worker_failure(
+        self, rank: int, code: int, stderr_path: str, tmp: str
+    ) -> BaseException:
+        """Best failure representation for a nonzero-exited worker: its own
+        recorded typed exception (restart policies and user except-clauses
+        dispatch on the type) with a stderr-tail DistributorError as cause,
+        or the DistributorError alone."""
+        with open(stderr_path, "rb") as f:
+            tail = f.read()[-_STDERR_TAIL:].decode(errors="replace")
+        launch_err = DistributorError(rank, code, tail)
+        recorded = self._recorded_error(os.path.join(tmp, f"result_{rank}.pkl"))
+        if recorded is not None:
+            recorded.__cause__ = launch_err
+            return recorded
+        return launch_err
+
+    @staticmethod
+    def _recorded_error(result_path: str) -> BaseException | None:
+        """The typed exception a failed worker pickled, if recoverable."""
+        try:
+            with open(result_path, "rb") as f:
+                outcome = pickle.load(f)
+            if not outcome.get("ok", True):
+                err = outcome.get("error")
+                if isinstance(err, BaseException):
+                    return err
+        except Exception:
+            pass
+        return None
 
 
 class ZeroDistributor(Distributor):

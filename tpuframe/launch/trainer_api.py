@@ -30,7 +30,7 @@ import tempfile
 import time
 from typing import Any, Callable, Mapping
 
-from tpuframe.launch.distributor import Distributor, DistributorError
+from tpuframe.launch.distributor import Distributor
 
 _RESULT_DIR_ENV = "TPUFRAME_RESULT_DIR"
 
@@ -155,8 +155,21 @@ class TPUTrainer:
         scaling_config: ScalingConfig | None = None,
         run_config: RunConfig | None = None,
     ):
+        import inspect
+
         self.train_loop = train_loop_per_worker
         self.config = dict(train_loop_config or {})
+        # Ray's contract: a loop that declares a parameter always receives
+        # the config (possibly {}), one that declares none never does.
+        self._loop_takes_config = any(
+            p.kind
+            in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                inspect.Parameter.VAR_POSITIONAL,
+            )
+            for p in inspect.signature(train_loop_per_worker).parameters.values()
+        )
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
 
@@ -166,9 +179,24 @@ class TPUTrainer:
         Worker failure lands in ``result.error`` (cell-8's ``result.error``
         check), not as a driver exception."""
         storage = os.path.expanduser(self.run_config.storage_path)
-        name = self.run_config.name or f"run_{time.strftime('%Y%m%d_%H%M%S')}"
-        result_dir = os.path.join(storage, name)
-        os.makedirs(result_dir, exist_ok=True)
+        os.makedirs(storage, exist_ok=True)
+        if self.run_config.name:
+            result_dir = os.path.join(storage, self.run_config.name)
+            os.makedirs(result_dir, exist_ok=True)
+            # A named run restarted = a fresh run: stale report history and
+            # checkpoint bundles must not leak into (or mask a crash of)
+            # this fit's Result — the report seq counter restarts at 0, so a
+            # surviving checkpoint_000001 would get new files overlaid on old.
+            for entry in os.listdir(result_dir):
+                path = os.path.join(result_dir, entry)
+                if entry.startswith("rank_") and entry.endswith(".jsonl"):
+                    os.remove(path)
+                elif entry.startswith("checkpoint_") and os.path.isdir(path):
+                    shutil.rmtree(path)
+        else:
+            result_dir = tempfile.mkdtemp(
+                prefix=f"run_{time.strftime('%Y%m%d_%H%M%S')}_", dir=storage
+            )
 
         dist = Distributor(
             num_processes=self.scaling.num_workers,
@@ -177,11 +205,13 @@ class TPUTrainer:
         )
         error: BaseException | None = None
         try:
-            if self.config:
+            if self._loop_takes_config:
                 dist.run(self.train_loop, self.config)
             else:
                 dist.run(self.train_loop)
-        except (DistributorError, Exception) as e:  # surface via Result
+        except (Exception, SystemExit) as e:
+            # Worker failure — including a train loop calling sys.exit() —
+            # lands in result.error, never as a driver exception.
             error = e
 
         history = self._read_history(result_dir, rank=0)

@@ -47,10 +47,43 @@ def _sanitize(key: str) -> str:
     return "/".join(parts) or "_"
 
 
+def _flat_key_path(base: str, rel: str) -> str:
+    """Flat fallback name for a nested key.  Leading '%' keeps it disjoint
+    from every sanitized key (% is in _INVALID, so no sanitized name starts
+    with it)."""
+    return os.path.join(base, "%" + rel.replace("/", "%2F"))
+
+
 def _key_file(base: str, key: str) -> str:
-    path = os.path.join(base, *(_sanitize(key).split("/")))
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    return path
+    """Writable path for ``key``.  '/' keys nest into directories; when a
+    nested path collides with an existing flat key (file where a directory
+    is needed, or vice versa — e.g. metric 'system' logged before
+    'system/cpu'), the key degrades to a flat percent-encoded file."""
+    rel = _sanitize(key)
+    nested = os.path.join(base, *rel.split("/"))
+    flat = _flat_key_path(base, rel)
+    if os.path.isfile(nested):
+        return nested
+    if os.path.isfile(flat):
+        return flat
+    try:
+        os.makedirs(os.path.dirname(nested), exist_ok=True)
+        if os.path.isdir(nested):
+            raise IsADirectoryError(nested)
+        return nested
+    except (FileExistsError, NotADirectoryError, IsADirectoryError):
+        os.makedirs(base, exist_ok=True)
+        return flat
+
+
+def _find_key_file(base: str, key: str) -> str:
+    """Read-side twin of :func:`_key_file`: nested location if present,
+    else the flat fallback."""
+    rel = _sanitize(key)
+    nested = os.path.join(base, *rel.split("/"))
+    if os.path.isfile(nested):
+        return nested
+    return _flat_key_path(base, rel)
 
 
 def _now_ms() -> int:
@@ -199,7 +232,7 @@ class Run:
 
     # -- reads (for tests / reload paths) ----------------------------------
     def get_metric_history(self, key: str) -> list[tuple[int, float, int]]:
-        path = os.path.join(self._dir, "metrics", *_sanitize(key).split("/"))
+        path = _find_key_file(os.path.join(self._dir, "metrics"), key)
         out = []
         try:
             with open(path) as f:
@@ -212,7 +245,7 @@ class Run:
 
     def get_param(self, key: str) -> str | None:
         try:
-            with open(os.path.join(self._dir, "params", *_sanitize(key).split("/"))) as f:
+            with open(_find_key_file(os.path.join(self._dir, "params"), key)) as f:
                 return f.read()
         except FileNotFoundError:
             return None
