@@ -1,0 +1,38 @@
+"""Pallas TPU kernels for tpuframe's hot ops.
+
+The reference rides on native CUDA kernels it never sees — cuDNN convs
+behind torchvision modules, DeepSpeed's fused Adam, NCCL collectives
+(SURVEY.md §2.3).  tpuframe's equivalents: XLA compiles the convs and
+collectives; this package hand-writes the remaining hot spots as Pallas
+kernels, each with a jnp reference implementation that is both the CPU
+fallback and the correctness oracle for tests.
+
+- :func:`normalize_images` — fused uint8→float, scale, per-channel
+  mean/std normalize in one VMEM pass (the input-pipeline hot op;
+  replaces torchvision's ToTensor+Normalize chain,
+  `/root/reference/utils/hf_dataset_utilities.py:58-81`).
+- :func:`fused_cross_entropy` — softmax cross entropy with a custom VJP
+  that recomputes the softmax in the backward kernel instead of
+  materializing it in HBM.
+- :func:`fused_adamw` — one-kernel AdamW moment+param update (the
+  DeepSpeed "fused Adam" role, engaged via its ZeRO configs,
+  `/root/reference/02_deepspeed/deepspeed_config.py:28-40`).
+"""
+
+from tpuframe.ops.dispatch import use_pallas
+from tpuframe.ops.normalize import normalize_images, normalize_images_reference
+from tpuframe.ops.cross_entropy import (
+    fused_cross_entropy,
+    cross_entropy_reference,
+)
+from tpuframe.ops.fused_adamw import fused_adamw, fused_adamw_update
+
+__all__ = [
+    "use_pallas",
+    "normalize_images",
+    "normalize_images_reference",
+    "fused_cross_entropy",
+    "cross_entropy_reference",
+    "fused_adamw",
+    "fused_adamw_update",
+]

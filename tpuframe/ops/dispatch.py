@@ -1,0 +1,25 @@
+"""Kernel dispatch: compiled Pallas on TPU, jnp reference elsewhere.
+
+Every op in tpuframe.ops has two implementations with identical
+semantics; tests assert they match (with ``interpret=True`` running the
+real kernel code on CPU).  ``TPUFRAME_DISABLE_PALLAS=1`` forces the
+reference path everywhere — the escape hatch when a kernel misbehaves
+on a new compiler version.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    """True when compiled Pallas kernels should run (TPU backend)."""
+    if os.environ.get("TPUFRAME_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def pad_to(x: int, multiple: int) -> int:
+    return (x + multiple - 1) // multiple * multiple

@@ -1,0 +1,181 @@
+"""Fused AdamW: one Pallas kernel per leaf for the whole moment+param update.
+
+The role DeepSpeed's fused CUDA Adam plays in the reference stack
+(engaged via its ZeRO configs, `/root/reference/02_deepspeed/
+deepspeed_config.py:28-40`): both moments and the parameter update in a
+single pass over each tensor — 4 reads + 3 writes of HBM instead of the
+~10+ traversals of a naive chain.  XLA usually fuses optax's update
+well on its own; this kernel pins the fusion and is the template for
+fancier updates (stochastic-rounded bf16 params).
+
+Exposed two ways:
+- :func:`fused_adamw_update` — leaf-level ``(p, g, m, v, step) -> (p', m', v')``.
+- :func:`fused_adamw` — an ``optax.GradientTransformation`` drop-in.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuframe.ops.dispatch import pad_to, use_pallas
+
+_LANES = 128
+_TILE_ROWS = 256
+
+
+def _update_math(p, g, m, v, t, *, lr, b1, b2, eps, weight_decay):
+    """Shared math (f32): AdamW with bias correction, decoupled decay.
+
+    ``b**t`` is computed as ``exp(t * log(b))`` — Mosaic has no powf
+    legalization for a traced exponent, and log(b) folds to a constant.
+    ``b == 0`` (momentum-free) short-circuits to 0**t = 0 for t >= 1.
+    """
+    import math
+
+    def pow_t(b):
+        return jnp.exp(t * math.log(b)) if b > 0.0 else jnp.zeros_like(t)
+
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    mhat = m / (1.0 - pow_t(b1))
+    vhat = v / (1.0 - pow_t(b2))
+    p = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p)
+    return p, m, v
+
+
+def _kernel(t_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref, **hp):
+    t = t_ref[0, 0].astype(jnp.float32)
+    p, m, v = _update_math(
+        p_ref[...].astype(jnp.float32),
+        g_ref[...].astype(jnp.float32),
+        m_ref[...].astype(jnp.float32),
+        v_ref[...].astype(jnp.float32),
+        t,
+        **hp,
+    )
+    po_ref[...] = p.astype(po_ref.dtype)
+    mo_ref[...] = m.astype(mo_ref.dtype)
+    vo_ref[...] = v.astype(vo_ref.dtype)
+
+
+def fused_adamw_update(
+    p: jax.Array,
+    g: jax.Array,
+    m: jax.Array,
+    v: jax.Array,
+    step: jax.Array,
+    *,
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    interpret: bool | None = None,
+):
+    """One-kernel AdamW for a single tensor; ``step`` is the 1-based count."""
+    hp = dict(lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    if interpret is None:
+        if not use_pallas():
+            t = step.astype(jnp.float32)
+            p_new, m_new, v_new = _update_math(
+                p.astype(jnp.float32), g.astype(jnp.float32),
+                m.astype(jnp.float32), v.astype(jnp.float32), t, **hp,
+            )
+            # Same dtype contract as the kernel path: params keep their
+            # dtype, moments are f32.
+            return p_new.astype(p.dtype), m_new, v_new
+        interpret = False
+
+    shape, dtype = p.shape, p.dtype
+    n = p.size
+    tile_rows = min(_TILE_ROWS, pad_to(-(-n // _LANES), 8))
+    rows = pad_to(-(-n // _LANES), tile_rows)  # ceil to whole tiles
+    padded = rows * _LANES
+
+    def flat(x):
+        return jnp.pad(x.reshape(-1), (0, padded - n)).reshape(rows, _LANES)
+
+    spec = pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))
+    scalar_spec = pl.BlockSpec(
+        (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+    )
+    out_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
+    po, mo, vo = pl.pallas_call(
+        functools.partial(_kernel, **hp),
+        out_shape=(out_shape, out_shape, out_shape),
+        grid=(rows // tile_rows,),
+        in_specs=[scalar_spec, spec, spec, spec, spec],
+        out_specs=(spec, spec, spec),
+        interpret=interpret,
+    )(step.reshape(1, 1).astype(jnp.float32), flat(p), flat(g), flat(m), flat(v))
+
+    def unflat(x, dt):
+        return x.reshape(padded)[:n].reshape(shape).astype(dt)
+
+    return unflat(po, dtype), unflat(mo, jnp.float32), unflat(vo, jnp.float32)
+
+
+class FusedAdamWState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def fused_adamw(
+    learning_rate: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """optax-compatible AdamW whose leaf updates run the fused kernel.
+
+    ``update`` returns deltas (optax contract), computed as
+    ``p_new - p`` from the fused result; ``mu``/``nu`` shard like params
+    under a ParallelPlan exactly as optax.adamw's state does.
+    """
+
+    def init(params):
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedAdamWState(
+            count=jnp.zeros((), jnp.int32), mu=zeros,
+            nu=jax.tree.map(jnp.copy, zeros),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adamw requires params in update()")
+        count = state.count + 1
+        step = count.astype(jnp.float32)
+
+        # Flatten/unflatten rather than a tuple-returning tree.map: the
+        # params pytree may itself contain tuples, which an is_leaf probe
+        # for the result triples would misparse.
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_m = treedef.flatten_up_to(state.mu)
+        leaves_v = treedef.flatten_up_to(state.nu)
+        results = [
+            fused_adamw_update(
+                p, g, m, v, step,
+                lr=learning_rate, b1=b1, b2=b2, eps=eps,
+                weight_decay=weight_decay,
+            )
+            for p, g, m, v in zip(leaves_p, leaves_g, leaves_m, leaves_v)
+        ]
+        updates = jax.tree.unflatten(
+            treedef,
+            [r[0].astype(p.dtype) - p for r, p in zip(results, leaves_p)],
+        )
+        mu = jax.tree.unflatten(treedef, [r[1] for r in results])
+        nu = jax.tree.unflatten(treedef, [r[2] for r in results])
+        return updates, FusedAdamWState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)

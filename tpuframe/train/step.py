@@ -31,9 +31,17 @@ LossFn = Callable[[jax.Array, jax.Array], jax.Array]
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Integer-label softmax cross entropy (≈ reference's ``nll_loss`` after
     log_softmax, `01_basic_torch_distributor.py:90-92,226`).  Supports soft
-    labels (N, C) for CutMix/LabelSmoothing mixtures."""
+    labels (N, C) for CutMix/LabelSmoothing mixtures.
+
+    (B,) integer labels route through the fused Pallas kernel on TPU
+    (recompute backward, no HBM softmax materialization); higher-rank
+    integer labels (sequence/patch losses) keep the optax path."""
     if labels.ndim == logits.ndim:
         return optax.softmax_cross_entropy(logits, labels)
+    if labels.ndim == 1 and logits.ndim == 2:
+        from tpuframe.ops import fused_cross_entropy
+
+        return fused_cross_entropy(logits, labels)
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
