@@ -83,7 +83,6 @@ def main() -> None:
         rates.append(batch * steps / elapsed)
     assert np.isfinite(float(metrics["loss_sum"]))
 
-    chips = max(jax.local_device_count(), 1)
     value = sorted(rates)[len(rates) // 2] / chips
     print(
         json.dumps(

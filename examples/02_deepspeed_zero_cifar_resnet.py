@@ -1,0 +1,145 @@
+"""ZeRO-sharded training through ZeroDistributor — DeepSpeed family, wired.
+
+Mirrors `/root/reference/02_deepspeed/` — with the crucial difference that
+the ZeRO config is actually engaged: the reference authored four stage
+dicts (`deepspeed_config.py:52-105`) but launched with plain Adam and the
+``deepspeedConfig`` argument commented out
+(`01_cifar_deepspeed_resnet.py:108,206`).  Here ``ZeroConfig`` travels
+through the launcher into the worker and becomes a ParallelPlan: stage 1/2
+shard the optimizer state over the fsdp axis (XLA turns the update into
+reduce-scatter -> sharded update -> all-gather), stage 3 shards the params
+themselves.  Per-epoch validation with early stopping (patience) follows
+the TinyImageNet variant (`02_tiny_imagenet_deepspeed_resnet.py:219-297`).
+
+Run:  python 02_deepspeed_zero_cifar_resnet.py --zero-stage 2 \
+          --num-processes 1 --simulate-devices 4
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from _common import base_parser
+from tpuframe import core
+from tpuframe.data import DataLoader, SyntheticImageDataset
+from tpuframe.launch import ZeroDistributor
+from tpuframe.models import ResNet18
+from tpuframe.parallel import ZeroConfig
+from tpuframe.train import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    merge_metrics,
+    summarize_metrics,
+)
+
+#: The reference's config dicts, reduced to what the TPU engine consumes.
+#: (`deepspeed_config.py` keys like allgather_bucket_size / overlap_comm
+#: have no XLA equivalent — the compiler schedules collectives itself.)
+ZERO_STAGES = {
+    0: ZeroConfig(stage=0),
+    1: ZeroConfig(stage=1),
+    2: ZeroConfig(stage=2),
+    3: ZeroConfig(stage=3),
+}
+
+
+def train_zero(cfg: dict, zero_config: ZeroConfig | None = None):
+    """Worker fn; ``zero_config`` is injected by ZeroDistributor."""
+    rt = core.initialize({"data": -1, "fsdp": cfg["fsdp"]})
+    zero_config = zero_config or ZeroConfig(stage=0)
+    plan = zero_config.plan(rt.mesh)
+
+    train_ds = SyntheticImageDataset(
+        n=cfg["train_samples"], image_size=cfg["image_size"],
+        num_classes=cfg["num_classes"], seed=cfg["seed"],
+    )
+    val_ds = SyntheticImageDataset(
+        n=cfg["eval_samples"], image_size=cfg["image_size"],
+        num_classes=cfg["num_classes"], seed=cfg["seed"] + 1,
+    )
+    train_loader = DataLoader(train_ds, cfg["batch_size"], shuffle=True, seed=cfg["seed"])
+    val_loader = DataLoader(val_ds, cfg["batch_size"], drop_last=False)
+
+    model = ResNet18(num_classes=cfg["num_classes"], stem="cifar")
+    # AdamW + warmup like the base config (`deepspeed_config.py:28-40`)
+    schedule = optax.linear_schedule(0.0, cfg["lr"], cfg["warmup_steps"])
+    state = create_train_state(
+        model, jax.random.PRNGKey(cfg["seed"]),
+        jnp.ones((1, cfg["image_size"], cfg["image_size"], 3)),
+        optax.adamw(schedule), plan=plan, init_kwargs={"train": False},
+    )
+    train_step = make_train_step()
+    eval_step = make_eval_step()
+
+    best_val, patience_left = float("inf"), cfg["patience"]
+    history = []
+    for epoch in range(cfg["epochs"]):
+        train_loader.set_epoch(epoch)
+        acc = None
+        for images, labels in train_loader:
+            batch = plan.shard_batch({"image": images, "label": labels})
+            state, metrics = train_step(state, batch)
+            acc = merge_metrics(acc, metrics)
+        summary = summarize_metrics(acc or {}, "train_")
+
+        vacc = None
+        for images, labels, mask in val_loader:
+            batch = plan.shard_batch({"image": images, "label": labels, "weight": mask})
+            vacc = merge_metrics(vacc, eval_step(state, batch))
+        summary.update(summarize_metrics(vacc or {}, "val_"))
+        history.append(summary)
+        if rt.is_main:
+            print(f"epoch {epoch}: {summary}")
+
+        # early stopping, patience like `02_tiny_imagenet_...py:289-297`
+        if summary["val_loss"] < best_val - cfg["min_delta"]:
+            best_val, patience_left = summary["val_loss"], cfg["patience"]
+        else:
+            patience_left -= 1
+            if patience_left <= 0:
+                break
+    return {"stage": zero_config.stage, "epochs_ran": len(history), **history[-1]}
+
+
+def main(argv=None):
+    p = base_parser(__doc__)
+    p.add_argument("--zero-stage", type=int, default=2, choices=[0, 1, 2, 3])
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1,
+                   help="fsdp mesh axis size inside each worker")
+    p.add_argument("--patience", type=int, default=3)
+    args = p.parse_args(argv)
+    cfg = {
+        "epochs": args.epochs,
+        "batch_size": args.batch_size,
+        "train_samples": args.train_samples,
+        "eval_samples": args.eval_samples,
+        "image_size": args.image_size,
+        "num_classes": args.num_classes,
+        "lr": args.lr,
+        "warmup_steps": 10,
+        "seed": args.seed,
+        "patience": args.patience,
+        "min_delta": 1e-4,
+        "fsdp": args.fsdp,
+    }
+    dist = ZeroDistributor(
+        num_processes=args.num_processes,
+        simulate_devices=args.simulate_devices,
+        zero_config=ZERO_STAGES[args.zero_stage],
+    )
+    result = dist.run(train_zero, cfg)
+    print("result:", result)
+    assert result["stage"] == args.zero_stage
+
+
+if __name__ == "__main__":
+    main()

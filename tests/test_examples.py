@@ -1,0 +1,84 @@
+"""Example-suite integration tests: replay each reference recipe family at
+1-epoch smoke scale (SURVEY.md §4's '1-epoch cheap run' formalized)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+
+SMOKE = [
+    "--epochs", "1",
+    "--batch-size", "16",
+    "--train-samples", "48",
+    "--eval-samples", "16",
+    "--image-size", "16",
+]
+
+
+def run_example(script: str, *extra: str, tmp_path):
+    env = dict(os.environ)
+    # pure-CPU children regardless of the image's TPU plugin hooks
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *SMOKE,
+         "--workdir", str(tmp_path), *extra],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed\n--- stdout ---\n{proc.stdout[-2000:]}"
+        f"\n--- stderr ---\n{proc.stderr[-3000:]}"
+    )
+    return proc.stdout
+
+
+def test_distributor_mnist(tmp_path):
+    out = run_example(
+        "01_distributor_mnist.py",
+        "--num-processes", "1", "--simulate-devices", "2",
+        tmp_path=tmp_path,
+    )
+    assert "finished" in out
+
+
+def test_distributor_cifar(tmp_path):
+    out = run_example(
+        "01_distributor_cifar_resnet.py",
+        "--num-processes", "1", "--simulate-devices", "2",
+        tmp_path=tmp_path,
+    )
+    assert "1 epoch:" in out and "demo_pred" in out
+
+
+@pytest.mark.parametrize("stage", ["2", "3"])
+def test_deepspeed_zero(tmp_path, stage):
+    out = run_example(
+        "02_deepspeed_zero_cifar_resnet.py",
+        "--zero-stage", stage, "--num-processes", "1",
+        "--simulate-devices", "2", "--fsdp", "2",
+        tmp_path=tmp_path,
+    )
+    assert f"'stage': {stage}" in out
+
+
+def test_composer_trainer(tmp_path):
+    out = run_example("03_composer_cifar_resnet.py", tmp_path=tmp_path)
+    assert "demo:" in out
+
+
+def test_accelerate_loop(tmp_path):
+    out = run_example("04_accelerate_cifar.py", tmp_path=tmp_path)
+    assert "epoch 0" in out
+
+
+def test_ray_trainer(tmp_path):
+    out = run_example(
+        "05_ray_fashion_mnist.py",
+        "--num-workers", "1", "--simulate-devices", "2",
+        tmp_path=tmp_path,
+    )
+    assert "reloaded checkpoint from epoch 0" in out

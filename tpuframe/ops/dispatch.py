@@ -13,12 +13,25 @@ import os
 
 import jax
 
+_FALSY = {"", "0", "false", "no", "off"}
+
 
 def use_pallas() -> bool:
-    """True when compiled Pallas kernels should run (TPU backend)."""
-    if os.environ.get("TPUFRAME_DISABLE_PALLAS"):
+    """True when compiled Pallas kernels should run.
+
+    Requires the TPU backend AND a single-device process:
+    ``pl.pallas_call`` lowers to a custom call the GSPMD partitioner
+    cannot split, so inside a multi-chip jit the kernel would force its
+    operands to replicate (an all-gather on the hot path).
+
+    ``TPUFRAME_DISABLE_PALLAS`` set to anything but a falsy value
+    ("", "0", "false", "no", "off") forces the reference path.
+    """
+    if os.environ.get("TPUFRAME_DISABLE_PALLAS", "").strip().lower() not in _FALSY:
         return False
-    return jax.default_backend() == "tpu"
+    if jax.default_backend() != "tpu":
+        return False
+    return jax.device_count() == 1
 
 
 def pad_to(x: int, multiple: int) -> int:

@@ -95,12 +95,17 @@ def fused_adamw_update(
 
     shape, dtype = p.shape, p.dtype
     n = p.size
-    tile_rows = min(_TILE_ROWS, pad_to(-(-n // _LANES), 8))
-    rows = pad_to(-(-n // _LANES), tile_rows)  # ceil to whole tiles
+    # Lane-aligned leaves skip the host-side pad copy; Pallas clips the
+    # ragged final row-tile itself.
+    rows = n // _LANES if n % _LANES == 0 else -(-n // _LANES)
     padded = rows * _LANES
+    tile_rows = min(_TILE_ROWS, pad_to(rows, 8))
 
     def flat(x):
-        return jnp.pad(x.reshape(-1), (0, padded - n)).reshape(rows, _LANES)
+        x = x.reshape(-1)
+        if padded != n:
+            x = jnp.pad(x, (0, padded - n))
+        return x.reshape(rows, _LANES)
 
     spec = pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))
     scalar_spec = pl.BlockSpec(
@@ -110,7 +115,7 @@ def fused_adamw_update(
     po, mo, vo = pl.pallas_call(
         functools.partial(_kernel, **hp),
         out_shape=(out_shape, out_shape, out_shape),
-        grid=(rows // tile_rows,),
+        grid=(-(-rows // tile_rows),),
         in_specs=[scalar_spec, spec, spec, spec, spec],
         out_specs=(spec, spec, spec),
         interpret=interpret,
@@ -138,8 +143,11 @@ def fused_adamw(
     """optax-compatible AdamW whose leaf updates run the fused kernel.
 
     ``update`` returns deltas (optax contract), computed as
-    ``p_new - p`` from the fused result; ``mu``/``nu`` shard like params
-    under a ParallelPlan exactly as optax.adamw's state does.
+    ``p_new - p`` from the fused result.  The kernel engages only in
+    single-device contexts (``use_pallas``): a pallas custom call cannot
+    be split by the GSPMD partitioner, so under a multi-chip mesh (ZeRO
+    sharded state) every leaf routes to the jnp math, which XLA shards
+    and fuses natively — same results either way.
     """
 
     def init(params):

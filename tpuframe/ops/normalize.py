@@ -65,24 +65,28 @@ def _kernel(x_ref, out_ref, *, weights, biases, n_channels, block_elems):
 
 def _pallas_normalize(flat, weights, biases, n_channels, out_dtype, interpret):
     n = flat.shape[0]
-    rows = pad_to(-(-n // _LANES), _TILE_ROWS)  # ceil to whole tiles
+    if n % _LANES == 0:
+        # Lane-aligned (all common vision shapes): no host-side pad copy;
+        # Pallas clips the ragged final row-tile itself.
+        rows = n // _LANES
+    else:
+        rows = -(-n // _LANES)
+        flat = jnp.pad(flat, (0, rows * _LANES - n))
     padded = rows * _LANES
-    flat = jnp.pad(flat, (0, padded - n))
-    grid = rows // _TILE_ROWS
-    block_elems = _TILE_ROWS * _LANES
+    tile = min(_TILE_ROWS, rows)
     kernel = functools.partial(
         _kernel,
         weights=weights,
         biases=biases,
         n_channels=n_channels,
-        block_elems=block_elems,
+        block_elems=tile * _LANES,
     )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rows, _LANES), out_dtype),
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((_TILE_ROWS, _LANES), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((_TILE_ROWS, _LANES), lambda i: (i, 0)),
+        grid=(-(-rows // tile),),
+        in_specs=[pl.BlockSpec((tile, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, _LANES), lambda i: (i, 0)),
         interpret=interpret,
     )(flat.reshape(rows, _LANES))
     return out.reshape(padded)[:n]

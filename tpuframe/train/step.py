@@ -33,9 +33,10 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     log_softmax, `01_basic_torch_distributor.py:90-92,226`).  Supports soft
     labels (N, C) for CutMix/LabelSmoothing mixtures.
 
-    (B,) integer labels route through the fused Pallas kernel on TPU
-    (recompute backward, no HBM softmax materialization); higher-rank
-    integer labels (sequence/patch losses) keep the optax path."""
+    (B,) integer labels route through the fused Pallas kernel on
+    single-chip TPU (recompute backward, no HBM softmax materialization);
+    multi-chip meshes and higher-rank integer labels keep the optax path
+    (a pallas custom call is opaque to the GSPMD partitioner)."""
     if labels.ndim == logits.ndim:
         return optax.softmax_cross_entropy(logits, labels)
     if labels.ndim == 1 and logits.ndim == 2:
