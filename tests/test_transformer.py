@@ -1,0 +1,106 @@
+"""Transformer LM + sequence-parallel training on the simulated mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.core import MeshSpec
+from tpuframe.core import runtime as rt
+from tpuframe.models.transformer import TransformerLM, transformer_tp_rules
+from tpuframe.parallel import ParallelPlan
+from tpuframe.train import create_train_state, make_train_step
+
+
+@pytest.fixture()
+def seq_runtime():
+    """Runtime with a dp x sp x tp mesh; restored after the test."""
+    rt.reset_runtime()
+    runtime = rt.initialize(MeshSpec(data=2, seq=2, model=2))
+    yield runtime
+    rt.reset_runtime()
+
+
+def _tokens(b=4, l=32, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, vocab, (b, l)).astype(np.int32))
+
+
+def test_full_vs_ring_forward_match(seq_runtime):
+    tokens = _tokens()
+    model_kw = dict(vocab_size=64, num_layers=2, num_heads=4, head_dim=8, max_len=64)
+    full = TransformerLM(attn_impl="full", **model_kw)
+    ring = TransformerLM(attn_impl="ring", **model_kw)
+    variables = full.init({"params": jax.random.PRNGKey(0)}, tokens, train=False)
+    out_full = full.apply(variables, tokens, train=False)
+    out_ring = ring.apply(variables, tokens, train=False)
+    assert out_full.shape == (4, 32, 64)
+    np.testing.assert_allclose(
+        np.asarray(out_full), np.asarray(out_ring), atol=2e-4
+    )
+
+
+def test_auto_dispatch_uses_ring_when_seq_sharded(seq_runtime):
+    # auto == ring on this mesh (seq axis size 2): outputs must match full
+    tokens = _tokens(b=2, l=16)
+    kw = dict(vocab_size=64, num_layers=1, num_heads=4, head_dim=8, max_len=32)
+    auto = TransformerLM(attn_impl="auto", **kw)
+    full = TransformerLM(attn_impl="full", **kw)
+    variables = auto.init({"params": jax.random.PRNGKey(1)}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(auto.apply(variables, tokens, train=False)),
+        np.asarray(full.apply(variables, tokens, train=False)),
+        atol=2e-4,
+    )
+
+
+def test_lm_train_step_dp_sp_tp(seq_runtime):
+    """Full training step: ZeRO-3 + TP rules + sequence-parallel ring
+    attention, one jitted step on the dp x sp x tp mesh."""
+    plan = ParallelPlan(
+        mesh=seq_runtime.mesh,
+        zero_stage=3,
+        rules=transformer_tp_rules(),
+        min_shard_elems=1,
+    )
+    model = TransformerLM(
+        vocab_size=64, num_layers=2, num_heads=4, head_dim=8, max_len=64,
+        attn_impl="auto",
+    )
+    tokens = _tokens(b=4, l=32)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), tokens[:1], optax.adamw(1e-3), plan=plan,
+        init_kwargs={"train": False},
+    )
+    # TP rules must actually shard a projection over 'model'
+    specs = jax.tree.map(lambda a: a.sharding.spec, state.params)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(specs)[0]
+    }
+    assert any("model" in str(s) for s in flat.values()), flat
+
+    step_fn = make_train_step()
+    labels = jnp.roll(tokens, -1, axis=1)
+    batch = plan.shard_batch({"input": tokens, "label": labels})
+    losses = []
+    for _ in range(3):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss_sum"]) / float(metrics["count"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # tiny batch memorizes fast
+
+
+def test_lm_without_runtime_defaults_to_full():
+    rt.reset_runtime()
+    try:
+        tokens = _tokens(b=2, l=8)
+        model = TransformerLM(
+            vocab_size=32, num_layers=1, num_heads=2, head_dim=4, max_len=16
+        )
+        variables = model.init({"params": jax.random.PRNGKey(0)}, tokens, train=False)
+        out = model.apply(variables, tokens, train=False)
+        assert out.shape == (2, 8, 32)
+    finally:
+        rt.reset_runtime()

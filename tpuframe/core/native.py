@@ -1,0 +1,259 @@
+"""Native (C++) extensions: lazy g++ build + ctypes bindings.
+
+Two components (SURVEY.md §2.3 — the native layers the reference consumes
+from its dependency stack):
+
+- :class:`ZstdCodec` — batch shard decompression on a GIL-free thread pool
+  (``tpuframe/_native/codec.cpp``), the mosaicml-streaming-native-codec
+  equivalent feeding the TFS streaming reader.
+- :class:`ControlPlane` — TCP rendezvous + barrier/broadcast/allgather of
+  host-side byte payloads (``tpuframe/_native/controlplane.cpp``), the
+  c10d/torchrun control surface (run-id broadcast, pre-jax rendezvous).
+  Works BEFORE `jax.distributed.initialize` — it is how hosts can agree on
+  a coordinator in the first place.
+
+Sources ship in-repo and compile lazily with g++ into
+``tpuframe/_native/build/`` keyed by a source hash; environments without a
+toolchain get ``native_available() == False`` and pure-Python fallbacks
+(the `zstandard` module; single-process no-op control plane).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+_LOCK = threading.Lock()
+_LIBS: dict[str, ctypes.CDLL | None] = {}
+
+
+def _build_and_load(name: str, source: str, extra_libs: Sequence[str]) -> ctypes.CDLL | None:
+    """Compile ``source`` (if stale) and dlopen it; None if unavailable."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        lib = None
+        try:
+            src_path = os.path.join(_NATIVE_DIR, source)
+            with open(src_path, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            so_path = os.path.join(_BUILD_DIR, f"lib{name}.{digest}.so")
+            if not os.path.exists(so_path):
+                tmp = f"{so_path}.tmp.{os.getpid()}"
+                cmd = [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    src_path, "-o", tmp, "-lpthread",
+                ] + [f"-l{l}" for l in extra_libs]
+                subprocess.run(
+                    cmd, check=True, capture_output=True, timeout=120
+                )
+                os.replace(tmp, so_path)  # atomic vs. concurrent builders
+            lib = ctypes.CDLL(so_path)
+        except Exception:
+            lib = None
+        _LIBS[name] = lib
+        return lib
+
+
+def _codec_lib():
+    lib = _build_and_load("tfscodec", "codec.cpp", ["zstd"])
+    if lib is not None and not getattr(lib, "_tf_sigs", False):
+        lib.tfs_compress_bound.restype = ctypes.c_size_t
+        lib.tfs_compress_bound.argtypes = [ctypes.c_size_t]
+        lib.tfs_frame_content_size.restype = ctypes.c_uint64
+        lib.tfs_frame_content_size.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tfs_compress.restype = ctypes.c_int
+        lib.tfs_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_int,
+        ]
+        lib.tfs_batch_decompress.restype = ctypes.c_int
+        lib._tf_sigs = True
+    return lib
+
+
+def native_available() -> bool:
+    """True when the C++ codec built (toolchain + libzstd present)."""
+    return _codec_lib() is not None
+
+
+class ZstdCodec:
+    """Batch zstd codec backed by the C++ thread pool.
+
+    ``decompress_batch`` releases the GIL for the whole batch — shard
+    blocks decode in parallel while Python goes on prefetching.
+    """
+
+    def __init__(self, n_threads: int | None = None):
+        self._lib = _codec_lib()
+        if self._lib is None:
+            raise RuntimeError("native codec unavailable (no g++/libzstd)")
+        self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+
+    def compress(self, data: bytes, level: int = 3) -> bytes:
+        lib = self._lib
+        cap = lib.tfs_compress_bound(len(data))
+        out = ctypes.create_string_buffer(cap)
+        out_size = ctypes.c_size_t()
+        rc = lib.tfs_compress(data, len(data), out, cap,
+                              ctypes.byref(out_size), level)
+        if rc != 0:
+            raise RuntimeError("zstd compress failed")
+        return out.raw[: out_size.value]
+
+    def decompress(self, data: bytes, max_output_size: int | None = None) -> bytes:
+        return self.decompress_batch([data], [max_output_size] if max_output_size else None)[0]
+
+    def decompress_batch(
+        self, blobs: Sequence[bytes], raw_sizes: Sequence[int] | None = None
+    ) -> list[bytes]:
+        """Decompress many frames at once (one C call, GIL released)."""
+        lib = self._lib
+        n = len(blobs)
+        if n == 0:
+            return []
+        caps = []
+        for i, blob in enumerate(blobs):
+            if raw_sizes is not None and raw_sizes[i]:
+                caps.append(int(raw_sizes[i]))
+            else:
+                size = lib.tfs_frame_content_size(blob, len(blob))
+                if size == 0:
+                    raise ValueError(f"frame {i}: unknown content size")
+                caps.append(int(size))
+        src_arr = (ctypes.c_char_p * n)(*blobs)
+        src_sizes = (ctypes.c_size_t * n)(*[len(b) for b in blobs])
+        outs = [ctypes.create_string_buffer(c) for c in caps]
+        dst_arr = (ctypes.c_void_p * n)(*[ctypes.addressof(o) for o in outs])
+        dst_caps = (ctypes.c_size_t * n)(*caps)
+        dst_sizes = (ctypes.c_size_t * n)()
+        rc = lib.tfs_batch_decompress(
+            ctypes.cast(src_arr, ctypes.POINTER(ctypes.c_char_p)),
+            src_sizes,
+            ctypes.cast(dst_arr, ctypes.POINTER(ctypes.c_char_p)),
+            dst_caps, dst_sizes, n, self.n_threads,
+        )
+        if rc != 0:
+            raise RuntimeError(f"zstd decompress failed on frame {rc - 1}")
+        return [outs[i].raw[: dst_sizes[i]] for i in range(n)]
+
+
+class ControlPlane:
+    """Host barrier/broadcast/allgather over the rank-0 hub.
+
+    >>> cp = ControlPlane(rank=r, world=n, address="10.0.0.1", port=29400)
+    >>> cp.barrier()
+    >>> run_id = cp.broadcast_str(run_id if r == 0 else None)
+    >>> all_hosts = cp.allgather_bytes(socket.gethostname().encode())
+    """
+
+    MAX_PAYLOAD = 1 << 20  # 1 MiB of control data per op
+
+    def __init__(
+        self,
+        rank: int | None = None,
+        world: int | None = None,
+        address: str | None = None,
+        port: int | None = None,
+        timeout_ms: int = 60_000,
+    ):
+        rank = int(os.environ.get("RANK", 0)) if rank is None else rank
+        world = int(os.environ.get("WORLD_SIZE", 1)) if world is None else world
+        if address is None:
+            address = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        if port is None:
+            port = int(os.environ.get("TPUFRAME_CP_PORT", "29401"))
+        self.rank, self.world = rank, world
+        self._h = None
+        self._lib = None
+        if world > 1:
+            lib = _build_and_load("tfcp", "controlplane.cpp", [])
+            if lib is None:
+                raise RuntimeError("control plane needs g++ (no toolchain found)")
+            if not getattr(lib, "_tf_sigs", False):
+                lib.tfcp_hub_create.restype = ctypes.c_void_p
+                lib.tfcp_hub_create.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+                lib.tfcp_spoke_create.restype = ctypes.c_void_p
+                lib.tfcp_spoke_create.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_int]
+                lib.tfcp_barrier.argtypes = [ctypes.c_void_p]
+                lib.tfcp_broadcast.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+                lib.tfcp_allgather.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.POINTER(ctypes.c_uint64)]
+                lib.tfcp_destroy.argtypes = [ctypes.c_void_p]
+                lib._tf_sigs = True
+            self._lib = lib
+            if rank == 0:
+                self._h = lib.tfcp_hub_create(b"", port, world, timeout_ms)
+            else:
+                self._h = lib.tfcp_spoke_create(
+                    address.encode(), port, rank, world, timeout_ms
+                )
+            if not self._h:
+                raise TimeoutError(
+                    f"control-plane rendezvous failed (rank {rank}/{world} "
+                    f"@ {address}:{port})"
+                )
+
+    def barrier(self) -> None:
+        if self.world == 1:
+            return
+        if self._lib.tfcp_barrier(self._h) != 0:
+            raise RuntimeError("control-plane barrier failed")
+
+    def broadcast_bytes(self, payload: bytes | None) -> bytes:
+        if self.world == 1:
+            return payload or b""
+        buf = ctypes.create_string_buffer(self.MAX_PAYLOAD)
+        size = ctypes.c_uint64(0)
+        if self.rank == 0:
+            payload = payload or b""
+            buf.raw = payload + b"\0" * (self.MAX_PAYLOAD - len(payload))
+            size.value = len(payload)
+        rc = self._lib.tfcp_broadcast(self._h, buf, ctypes.byref(size), self.MAX_PAYLOAD)
+        if rc != 0:
+            raise RuntimeError(f"control-plane broadcast failed ({rc})")
+        return payload if self.rank == 0 else buf.raw[: size.value]
+
+    def broadcast_str(self, value: str | None) -> str:
+        return self.broadcast_bytes(value.encode() if value else None).decode()
+
+    def allgather_bytes(self, payload: bytes) -> list[bytes]:
+        if self.world == 1:
+            return [payload]
+        out = ctypes.create_string_buffer(self.MAX_PAYLOAD)
+        sizes = (ctypes.c_uint64 * self.world)()
+        rc = self._lib.tfcp_allgather(
+            self._h, payload, len(payload), out, self.MAX_PAYLOAD, sizes
+        )
+        if rc != 0:
+            raise RuntimeError(f"control-plane allgather failed ({rc})")
+        parts, off = [], 0
+        for i in range(self.world):
+            parts.append(out.raw[off : off + sizes[i]])
+            off += sizes[i]
+        return parts
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tfcp_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

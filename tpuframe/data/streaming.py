@@ -88,6 +88,38 @@ def _get_zstd():
     return zstandard
 
 
+_NATIVE_CODEC = None
+_NATIVE_TRIED = False
+
+
+def _native_codec():
+    """The C++ batch codec (tpuframe.core.native), or None w/o a toolchain."""
+    global _NATIVE_CODEC, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            from tpuframe.core.native import ZstdCodec
+
+            _NATIVE_CODEC = ZstdCodec()
+        except Exception:
+            _NATIVE_CODEC = None
+    return _NATIVE_CODEC
+
+
+def _zstd_compress(raw: bytes, level: int) -> bytes:
+    codec = _native_codec()
+    if codec is not None:
+        return codec.compress(raw, level)
+    return _get_zstd().ZstdCompressor(level=level).compress(raw)
+
+
+def _zstd_decompress(data: bytes, raw_bytes: int) -> bytes:
+    codec = _native_codec()
+    if codec is not None:
+        return codec.decompress(data, max_output_size=raw_bytes)
+    return _get_zstd().ZstdDecompressor().decompress(data, max_output_size=raw_bytes)
+
+
 # ---------------------------------------------------------------------------
 # writer
 # ---------------------------------------------------------------------------
@@ -143,7 +175,7 @@ class ShardWriter:
             return
         raw = msgpack.packb(self._buf, use_bin_type=True)
         if self.compression == "zstd":
-            data = _get_zstd().ZstdCompressor(level=self.compression_level).compress(raw)
+            data = _zstd_compress(raw, self.compression_level)
         else:
             data = raw
         name = f"shard.{len(self._shards):05d}.tfs"
@@ -276,9 +308,7 @@ class StreamingDataset:
                     f"checksum mismatch on {shard['file']}: {digest} != {shard['sha256']}"
                 )
         if self.index["compression"] == "zstd":
-            data = _get_zstd().ZstdDecompressor().decompress(
-                data, max_output_size=shard["raw_bytes"]
-            )
+            data = _zstd_decompress(data, shard["raw_bytes"])
         records = msgpack.unpackb(data, raw=True)
         with self._lock:
             self._decoded[shard_idx] = records

@@ -9,6 +9,7 @@ and frozen-backbone transfer-learning wrappers
 """
 
 from tpuframe.models.cnn import MnistNet
+from tpuframe.models.transformer import TransformerLM, transformer_tp_rules
 from tpuframe.models.resnet import (
     BasicBlock,
     Bottleneck,
@@ -22,6 +23,8 @@ from tpuframe.models.transfer import TransferClassifier, backbone_frozen_labels
 
 __all__ = [
     "MnistNet",
+    "TransformerLM",
+    "transformer_tp_rules",
     "BasicBlock",
     "Bottleneck",
     "ResNet",

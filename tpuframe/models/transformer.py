@@ -1,0 +1,174 @@
+"""Decoder-only transformer LM with sequence-parallel ring attention.
+
+The reference repo is vision-only (SURVEY.md §5: no attention models
+anywhere), but long-context training is first-class in tpuframe: this
+family is the workload that exercises the ``seq`` mesh axis.  Design:
+
+- NHWC-free (B, L, D) layout; bf16-ready via ``dtype``.
+- Attention dispatch: ``attn_impl="auto"`` uses exact ring attention
+  (`tpuframe.ops.ring_attention`) whenever the current mesh shards the
+  sequence axis — K/V rotate the ICI ring, scores never materialize
+  globally — and plain XLA attention otherwise.
+- Tensor-parallel ready: :func:`transformer_tp_rules` gives the
+  ParallelPlan rules that split QKV/MLP projections over ``model``
+  (Megatron-style column->row pairing; XLA inserts the all-reduces).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQUENCE_AXIS,
+    current_runtime,
+)
+from tpuframe.ops.ring_attention import attention_reference, ring_attention_local
+
+
+def transformer_tp_rules():
+    """ParallelPlan TP rules: column-parallel QKV/fc1, row-parallel out/fc2
+    (≈ Megatron sharding, expressed declaratively)."""
+    return (
+        (r"(query|key|value)/kernel", P(None, MODEL_AXIS)),
+        (r"attn_out/kernel", P(MODEL_AXIS, None)),
+        (r"mlp_in/kernel", P(None, MODEL_AXIS)),
+        (r"mlp_out/kernel", P(MODEL_AXIS, None)),
+        (r"embed/embedding", P(None, MODEL_AXIS)),
+        (r"lm_head/kernel", P(None, MODEL_AXIS)),
+    )
+
+
+def _mesh_or_none():
+    try:
+        return current_runtime(auto_init=False).mesh
+    except RuntimeError:
+        return None
+
+
+class SelfAttention(nn.Module):
+    """Causal multi-head self-attention with ring/full dispatch."""
+
+    num_heads: int
+    head_dim: int
+    causal: bool = True
+    attn_impl: str = "auto"  # "auto" | "full" | "ring"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        features = self.num_heads * self.head_dim
+        dense = lambda name: nn.Dense(  # noqa: E731
+            features, use_bias=False, dtype=self.dtype, name=name
+        )
+        b, l, _ = x.shape
+        heads = (b, l, self.num_heads, self.head_dim)
+        q = dense("query")(x).reshape(heads)
+        k = dense("key")(x).reshape(heads)
+        v = dense("value")(x).reshape(heads)
+
+        impl = self.attn_impl
+        mesh = _mesh_or_none()
+        if self.is_initializing():
+            # init traces with a sample batch that need not divide the mesh;
+            # attention has no params, so the full path initializes
+            # identically to ring.
+            impl = "full"
+        elif impl == "auto":
+            seq_sharded = mesh is not None and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
+            impl = "ring" if seq_sharded else "full"
+        if impl == "ring":
+            if mesh is None:
+                raise ValueError("attn_impl='ring' needs an initialized runtime mesh")
+            head_axis = MODEL_AXIS if (
+                mesh.shape.get(MODEL_AXIS, 1) > 1
+                and self.num_heads % mesh.shape[MODEL_AXIS] == 0
+            ) else None
+            spec = P((DATA_AXIS, FSDP_AXIS), SEQUENCE_AXIS, head_axis, None)
+            out = jax.shard_map(
+                lambda q, k, v: ring_attention_local(q, k, v, causal=self.causal),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        else:
+            out = attention_reference(q, k, v, causal=self.causal)
+        out = out.reshape(b, l, features)
+        return nn.Dense(
+            x.shape[-1], use_bias=False, dtype=self.dtype, name="attn_out"
+        )(out)
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block: LN -> attn -> +res, LN -> MLP -> +res."""
+
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    causal: bool = True
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        y = SelfAttention(
+            self.num_heads, self.head_dim, causal=self.causal,
+            attn_impl=self.attn_impl, dtype=self.dtype, name="attn",
+        )(y, train=train)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = nn.Dense(
+            d * self.mlp_ratio, dtype=self.dtype, name="mlp_in"
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, dtype=self.dtype, name="mlp_out")(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: (B, L) int tokens -> (B, L, vocab) logits."""
+
+    vocab_size: int
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 32
+    max_len: int = 2048
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, train: bool = False) -> jax.Array:
+        d_model = self.num_heads * self.head_dim
+        x = nn.Embed(self.vocab_size, d_model, dtype=self.dtype, name="embed")(tokens)
+        pos = nn.Embed(self.max_len, d_model, dtype=self.dtype, name="pos_embed")(
+            jnp.arange(tokens.shape[1])[None, :]
+        )
+        x = x + pos
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads, self.head_dim, mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout, causal=True, attn_impl=self.attn_impl,
+                dtype=self.dtype, name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)

@@ -26,8 +26,16 @@ from tpuframe.ops.cross_entropy import (
     cross_entropy_reference,
 )
 from tpuframe.ops.fused_adamw import fused_adamw, fused_adamw_update
+from tpuframe.ops.ring_attention import (
+    attention_reference,
+    ring_attention,
+    ring_attention_local,
+)
 
 __all__ = [
+    "attention_reference",
+    "ring_attention",
+    "ring_attention_local",
     "use_pallas",
     "normalize_images",
     "normalize_images_reference",

@@ -1,0 +1,131 @@
+"""Ring attention: exact attention over sequence shards on a ring (SP/CP).
+
+Long-context sequence/context parallelism for tpuframe (absent from the
+vision-only reference — SURVEY.md §5 — but first-class here): each device
+holds a sequence shard of Q/K/V; K/V blocks rotate around the ``seq`` mesh
+axis with ``jax.lax.ppermute`` (nearest-neighbour ICI hops) while every
+device accumulates its queries' attention with an online-softmax, so the
+full (L, L) score matrix never materializes and memory stays O(L/N * L/N)
+per step.  Results are exact — identical to full attention — for both
+causal and bidirectional masks.
+
+Layout: per-device shards (batch, seq_local, heads, head_dim); the global
+sequence is the concatenation of shards in ``seq``-axis index order.
+
+Two entry points:
+- :func:`ring_attention_local` — the per-device body; call it inside an
+  existing ``shard_map`` (how the transformer blocks use it).
+- :func:`ring_attention` — convenience wrapper that builds the shard_map
+  over a mesh for standalone use/tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Full (unsharded) attention oracle, (B, L, H, D) layout."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qi = jnp.arange(q.shape[1])[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(ki <= qi, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_update(q, k, v, o, l, m, q_pos, k_pos, causal, scale):
+    """Online-softmax accumulation of one K/V block into (o, l, m)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # (B, H, Lq, Lk)
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]  # (Lq, Lk)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (B, H, Lq)
+    # exp(-inf - m) -> 0 handles fully-masked rows; keep m finite
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])  # (B, H, Lq, Lk)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), m_new, m - m_new))
+    correction = jnp.where(jnp.isneginf(m_new), 0.0, correction)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, l_new, m_new
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Per-device ring attention body (call under shard_map).
+
+    Args are this device's shards, (B, L_local, H, D).  K/V travel the
+    ring ``axis_size`` times; the python loop is a static unroll (the ring
+    size is a mesh constant), which keeps AD straightforward and lets XLA
+    overlap each hop's ppermute with the previous block's compute.
+    """
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    lk = k.shape[1]
+
+    q_pos = my_idx * lq + jnp.arange(lq)
+    o = jnp.zeros((b, lq, h, d), jnp.float32)
+    l = jnp.zeros((b, h, lq), jnp.float32)
+    m = jnp.full((b, h, lq), -jnp.inf, jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for step in range(axis_size):
+        # after `step` hops, this device holds the block that started at
+        # ring position (my_idx - step)
+        src = (my_idx - step) % axis_size
+        k_pos = src * lk + jnp.arange(lk)
+        o, l, m = _block_update(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            o, l, m, q_pos, k_pos, causal, scale,
+        )
+        if step + 1 < axis_size:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (strict causal pad) -> 0
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    causal: bool = False,
+    seq_axis: str = SEQUENCE_AXIS,
+    batch_axes=(DATA_AXIS, FSDP_AXIS),
+    head_axis: str | None = None,
+) -> jax.Array:
+    """shard_map wrapper: global (B, L, H, D) arrays over ``mesh``.
+
+    Batch splits over ``batch_axes``, sequence over ``seq_axis``, heads
+    over ``head_axis`` (tensor parallel) when given.
+    """
+    spec = P(tuple(batch_axes), seq_axis, head_axis, None)
+    fn = functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

@@ -57,7 +57,10 @@ def _forward(state: TrainState, params: Any, batch: Mapping[str, jax.Array],
     kwargs: dict[str, Any] = {"train": train}
     if train and rng is not None:
         kwargs["rngs"] = {"dropout": rng}
-    x = policy.cast_batch(batch["image"])
+    # "input" is the generic key (token ids, features); "image" the vision
+    # alias the reference examples use.  Int inputs pass cast_batch untouched.
+    x = batch["input"] if "input" in batch else batch["image"]
+    x = policy.cast_batch(x)
     if train and has_stats:
         logits, updates = state.apply_fn(
             variables, x, mutable=["batch_stats"], **kwargs
@@ -97,8 +100,8 @@ def make_train_step(
         )(state.params)
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
         labels = batch["label"]
-        hard = labels if labels.ndim == 1 else jnp.argmax(labels, -1)
-        n = jnp.asarray(labels.shape[0], jnp.float32)
+        hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
+        n = jnp.asarray(hard.size, jnp.float32)  # tokens for LM, images for vision
         metrics = {
             "loss_sum": loss * n,
             "correct": jnp.sum(jnp.argmax(logits, -1) == hard).astype(jnp.float32),
@@ -126,11 +129,13 @@ def make_eval_step(
             state, state.params, batch, policy, False, None, loss_fn
         )
         labels = batch["label"]
-        hard = labels if labels.ndim == 1 else jnp.argmax(labels, -1)
+        hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
         weight = batch.get("weight")
         if weight is None:
             weight = jnp.ones_like(losses)
         weight = weight.astype(jnp.float32)
+        if weight.ndim < losses.ndim:  # per-example mask over per-token losses
+            weight = weight.reshape(weight.shape + (1,) * (losses.ndim - weight.ndim))
         return {
             "loss_sum": jnp.sum(losses * weight),
             "correct": jnp.sum(
@@ -192,8 +197,8 @@ def make_grad_accum_step(
                 compute_loss, has_aux=True
             )(state.params)
             labels = mb["label"]
-            hard = labels if labels.ndim == 1 else jnp.argmax(labels, -1)
-            n = jnp.asarray(labels.shape[0], jnp.float32)
+            hard = jnp.argmax(labels, -1) if labels.ndim == logits.ndim else labels
+            n = jnp.asarray(hard.size, jnp.float32)  # tokens for LM, images for vision
             metrics = {
                 "loss_sum": metrics["loss_sum"] + loss * n,
                 "correct": metrics["correct"]
