@@ -42,6 +42,20 @@ def test_ring_with_tensor_parallel_heads():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_ring_large_logits_no_nan(causal):
+    # Attention logits beyond exp's f32 overflow point (~88): the first
+    # block processed by each device has running max -inf, and a naive
+    # online-softmax correction exp(m_new) would be inf → 0*inf = NaN.
+    mesh = MeshSpec(data=2, seq=4).build()
+    q, k, v = _qkv(b=2, l=32, h=2, d=8, seed=3)
+    q = q * 60.0  # scores ~ q·k/sqrt(d): drive past 100
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    assert np.isfinite(np.asarray(got)).all()
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_gradients_match(causal):
     mesh = MeshSpec(data=2, seq=4).build()
     q, k, v = _qkv(b=2, l=16, h=2, d=4)

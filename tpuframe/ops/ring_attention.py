@@ -56,7 +56,10 @@ def _block_update(q, k, v, o, l, m, q_pos, k_pos, causal, scale):
     # exp(-inf - m) -> 0 handles fully-masked rows; keep m finite
     m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
     p = jnp.exp(s - m_safe[..., None])  # (B, H, Lq, Lk)
-    correction = jnp.exp(jnp.where(jnp.isneginf(m), m_new, m - m_new))
+    # When the prior running max m is -inf (first block, or fully-masked so
+    # far) the correct correction is 0, not exp(m_new): o and l are still 0,
+    # and exp(m_new) overflows to inf for large logits, turning 0*inf → NaN.
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_new))
     correction = jnp.where(jnp.isneginf(m_new), 0.0, correction)
     l_new = l * correction + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
