@@ -1,27 +1,62 @@
 #!/usr/bin/env python
 """Headline benchmark: ResNet50 ImageNet-shape train-step throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend",
+"mfu", ...}.
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
 compares against an estimate of the reference hardware's capability:
 ~400 images/sec for ResNet50 mixed-precision training on one A10G (the
-per-GPU rate the reference's 4xA10G DDP examples would sustain).
+per-GPU rate the reference's 4xA10G DDP examples would sustain, matching
+the timing hooks at `/root/reference/01_torch_distributor/
+01_basic_torch_distributor.py:376-378`).
 
-On TPU: bf16 compute, 224px ImageNet shapes, donated jitted step.
+Robustness contract (VERDICT r01 #1): the benchmark itself runs in a
+child process; the parent retries transient backend-init failures with
+backoff, then falls back to ``JAX_PLATFORMS=''`` auto-selection and
+finally to CPU, so a degraded run is *labeled* (``backend`` field) rather
+than an rc=1 with no number.
+
+On TPU: bf16 compute, 224px ImageNet shapes, donated jitted step, MFU
+computed from XLA's compiled-program FLOP count against the chip's peak.
 On CPU (smoke): tiny shapes so the script stays runnable anywhere.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 # Reference-hardware estimate (A10G, ResNet50, mixed precision), img/s/GPU.
 BASELINE_IMG_PER_SEC = 400.0
 
+_CHILD_ENV = "TPUFRAME_BENCH_CHILD"
 
-def main() -> None:
+# Peak bf16 FLOP/s per chip, keyed by substring of jax device_kind.
+# (Public figures: v2 46, v3 123, v4 275, v5e/"v5 lite" 197, v5p 459,
+# v6e/Trillium 918 TFLOP/s.)
+_PEAK_BF16 = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e reports device_kind "TPU v5 lite*"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def _run_bench() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -63,9 +98,29 @@ def main() -> None:
         }
     )
 
-    # Compile + warmup (first step compiles, second settles caches).
+    # AOT-compile once and reuse the executable for warmup + benchmark
+    # (jit's call path would not share the AOT cache — compiling twice
+    # costs minutes).  Cost analysis reports the FLOPs of the *per-device*
+    # partitioned program; best-effort (some PJRT plugins omit it), with
+    # the standard analytic ResNet50 count as fallback (~4.09 GFLOP
+    # forward/image at 224px, x3 for fwd+bwd, divided over chips).
+    compiled = step_fn.lower(state, data).compile()
+    flops_per_dev_step: float | None = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", -1.0)) if ca else -1.0
+        if flops > 0:
+            flops_per_dev_step = flops
+    except Exception:
+        pass
+    if flops_per_dev_step is None and size == 224:
+        flops_per_dev_step = 3 * 4.09e9 * batch / chips
+
+    # Warmup (settles caches and async dispatch).
     for _ in range(2):
-        state, metrics = step_fn(state, data)
+        state, metrics = compiled(state, data)
     jax.block_until_ready((state, metrics))
 
     # Median-of-rounds with a joint block on the full output pytree each
@@ -76,7 +131,7 @@ def main() -> None:
         step_before = int(state.step)
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, metrics = step_fn(state, data)
+            state, metrics = compiled(state, data)
         jax.block_until_ready((state, metrics))
         elapsed = time.perf_counter() - t0
         assert int(state.step) == step_before + steps
@@ -84,6 +139,16 @@ def main() -> None:
     assert np.isfinite(float(metrics["loss_sum"]))
 
     value = sorted(rates)[len(rates) // 2] / chips
+
+    device_kind = jax.devices()[0].device_kind
+    peak = _peak_flops(device_kind) if on_accel else None
+    mfu = None
+    if peak and flops_per_dev_step:
+        # Per-device FLOP rate vs the chip's peak: the per-device program
+        # runs (global images/sec / batch) = (value * chips / batch)
+        # steps/sec on every chip.
+        mfu = round(flops_per_dev_step * value * chips / batch / peak, 4)
+
     print(
         json.dumps(
             {
@@ -92,6 +157,81 @@ def main() -> None:
                 "unit": f"images/sec/chip (batch={batch}, {size}px, "
                 f"{'bf16' if on_accel else 'fp32'}, {jax.default_backend()})",
                 "vs_baseline": round(value / BASELINE_IMG_PER_SEC, 3),
+                "backend": jax.default_backend(),
+                "device_kind": device_kind,
+                "chips": chips,
+                "images_per_sec_per_chip": round(value, 2),
+                "mfu": mfu,
+            }
+        )
+    )
+
+
+def _last_json_line(text: str) -> str | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    if os.environ.get(_CHILD_ENV):
+        _run_bench()
+        return
+
+    # (extra-env, pre-sleep seconds).  Attempt 2 retries the default
+    # backend after a backoff — r01 died on a transient TPU-init failure.
+    attempts = [
+        ({}, 0.0),
+        ({}, 15.0),
+        ({"JAX_PLATFORMS": ""}, 5.0),  # let jax auto-pick what's available
+        ({"JAX_PLATFORMS": "cpu"}, 0.0),  # guaranteed degraded fallback
+    ]
+    last_err = ""
+    timed_out: set[str] = set()
+    for extra, pre_sleep in attempts:
+        # A timeout is deterministic (backend too slow/hung), not transient:
+        # don't retry an environment whose *effective* backend selection
+        # already timed out (JAX_PLATFORMS='' is the same as unset).
+        effective = {**os.environ, **extra}.get("JAX_PLATFORMS", "")
+        if effective in timed_out:
+            continue
+        if pre_sleep:
+            time.sleep(pre_sleep)
+        env = {**os.environ, **extra, _CHILD_ENV: "1"}
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=2400,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = "benchmark child timed out"
+            timed_out.add(effective)
+            continue
+        line = _last_json_line(proc.stdout)
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+        last_err = (proc.stderr or proc.stdout or "").strip()[-500:]
+
+    # Never exit nonzero: emit a labeled failure record the driver can parse.
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "images/sec/chip (no backend available)",
+                "vs_baseline": 0.0,
+                "backend": "none",
+                "error": last_err,
             }
         )
     )
