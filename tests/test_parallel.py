@@ -168,3 +168,100 @@ class TestPrecision:
         assert get_policy("bf16").compute_dtype == jnp.bfloat16
         with pytest.raises(ValueError):
             get_policy("fp8_nope")
+
+
+class TestHostOffload:
+    def _shapes(self):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.zeros((64, 128)), "b": jnp.zeros((128,))}
+        tx = __import__("optax").adamw(1e-3)
+        return params, tx.init(params)
+
+    def test_offload_skipped_without_host_memory(self, mesh8):
+        # CPU simulation has no pinned_host space: the flag must downgrade
+        # gracefully to plain stage-3 shardings and stay runnable.
+        from tpuframe.parallel import ParallelPlan, supports_host_offload
+
+        assert not supports_host_offload()  # CPU backend in tests
+        params, opt = self._shapes()
+        plan = ParallelPlan(
+            mesh=mesh8, zero_stage=3, min_shard_elems=1, offload_optimizer=True
+        )
+        shardings = plan.state_shardings(opt, params)
+        for s in __import__("jax").tree.leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "memory_kind")
+        ):
+            assert s.memory_kind in (None, "device", "unpinned_host") or (
+                s.memory_kind != "pinned_host"
+            )
+
+    def test_offload_spec_plumbing_when_supported(self, mesh8, monkeypatch):
+        # Pretend the backend supports pinned_host: non-scalar optimizer
+        # leaves must get the host memory kind, scalars stay on device.
+        import jax
+
+        from tpuframe.parallel import ParallelPlan
+        from tpuframe.parallel import sharding as sh
+
+        monkeypatch.setattr(sh, "host_memory_available", lambda mesh=None: True)
+        params, opt = self._shapes()
+        plan = ParallelPlan(
+            mesh=mesh8, zero_stage=3, min_shard_elems=1, offload_optimizer=True
+        )
+        shardings = plan.state_shardings(opt, params)
+        leaves = jax.tree_util.tree_flatten_with_path(
+            shardings, is_leaf=lambda x: hasattr(x, "memory_kind")
+        )[0]
+        kinds = {sh_mod.memory_kind for _, sh_mod in leaves}
+        assert "pinned_host" in kinds
+        # the adamw count scalar stays deviceside
+        for path, s in leaves:
+            if "count" in "/".join(str(k) for k in path):
+                assert s.memory_kind != "pinned_host"
+
+    def test_zero_3_offload_preset_and_from_dict(self, mesh8):
+        from tpuframe.parallel import ZeroConfig, zero_3_offload
+
+        plan = zero_3_offload(mesh8)
+        assert plan.zero_stage == 3 and plan.offload_optimizer
+        cfg = ZeroConfig.from_dict(
+            {"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}}}
+        )
+        assert cfg.stage == 3 and cfg.offload_optimizer
+        assert ZeroConfig.from_dict(
+            {"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "none"}}}
+        ).offload_optimizer is False
+
+    def test_offload_flag_end_to_end_on_cpu(self, mesh8):
+        # create_train_state with an offload plan on CPU: graceful skip,
+        # trainable one step.
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from tpuframe.models import MnistNet
+        from tpuframe.parallel import zero_3_offload
+        from tpuframe.train import create_train_state, make_train_step
+
+        from tpuframe.parallel import ZeroConfig
+
+        plan = ZeroConfig(stage=3, offload_optimizer=True, min_shard_elems=1).plan(mesh8)
+        state = create_train_state(
+            MnistNet(num_classes=10),
+            jax.random.PRNGKey(0),
+            jnp.ones((1, 28, 28, 1)),
+            optax.adamw(1e-3),
+            plan=plan,
+            init_kwargs={"train": False},
+        )
+        batch = plan.shard_batch(
+            {
+                "image": np.random.default_rng(0).random((8, 28, 28, 1)).astype(np.float32),
+                "label": np.random.default_rng(0).integers(0, 10, (8,)).astype(np.int32),
+            }
+        )
+        step = make_train_step(plan=plan)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss_sum"]))

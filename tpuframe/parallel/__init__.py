@@ -27,6 +27,7 @@ from tpuframe.parallel.zero import (
     zero_1,
     zero_2,
     zero_3,
+    zero_3_offload,
 )
 
 __all__ = [
@@ -46,4 +47,5 @@ __all__ = [
     "zero_1",
     "zero_2",
     "zero_3",
+    "zero_3_offload",
 ]

@@ -38,6 +38,24 @@ from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS
 Rule = tuple[str, P]
 
 
+def host_memory_available(mesh: Mesh | None = None) -> bool:
+    """True when host-offloaded placement actually works: a real TPU
+    backend whose devices expose a ``pinned_host`` memory space.
+
+    The CPU simulation backend *lists* pinned_host but cannot compile
+    SPMD programs with host-placement annotations ("side-effect ops
+    cannot be replicated"), so CPU always returns False — offload plans
+    downgrade gracefully in tests/dryruns."""
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        devs = mesh.devices.flat if mesh is not None else jax.devices()
+        dev = next(iter(devs))
+        return any(m.kind == "pinned_host" for m in dev.addressable_memories())
+    except Exception:  # pragma: no cover - backend-dependent
+        return False
+
+
 def path_str(path: tuple) -> str:
     """Render a jax tree path as ``a/b/c`` (DictKey/SequenceKey/attr agnostic)."""
     parts = []
@@ -92,10 +110,18 @@ class ParallelPlan:
     min_shard_elems: int = 2**14
     fsdp_axis: str = FSDP_AXIS
     data_axes: Sequence[str] = (DATA_AXIS, FSDP_AXIS)
+    #: DeepSpeed stage-3 CPU offload (`deepspeed_config.py:87-105`):
+    #: optimizer-state leaves live in pinned host memory and stream to HBM
+    #: inside the update.  Applied only when the backend has a
+    #: ``pinned_host`` memory space (real TPUs); CPU simulation skips it.
+    offload_optimizer: bool = False
 
     def __post_init__(self):
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_stage must be 0..3, got {self.zero_stage}")
+
+    def _offload_active(self) -> bool:
+        return self.offload_optimizer and host_memory_available(self.mesh)
 
     # -- axis helpers ------------------------------------------------------
     def axis_size(self, name: str) -> int:
@@ -170,17 +196,28 @@ class ParallelPlan:
 
         return jax.tree_util.tree_map_with_path(assign, params)
 
-    def state_shardings(self, state: Any, params: Any) -> Any:
+    def state_shardings(self, state: Any, params: Any, with_offload: bool = True) -> Any:
         """Pytree of NamedSharding for an optax state mirroring ``params``.
 
         Param-shaped leaves inside the state (``mu``/``nu``/trace buffers —
         optax builds them with the params' own tree structure, so their tree
         paths end with the param's path) get the param-aligned spec with the
         ZeRO-stage fsdp sharding layered on; scalars (step counts) replicate.
+
+        ``with_offload=False`` suppresses the pinned-host memory kind even
+        when offload is active — used for shardings that must be legal
+        inside a jit's ``out_shardings`` (XLA rejects memory-kind
+        annotations there); the caller then ``device_put``s to the
+        offloaded shardings afterwards.
         """
         param_paths = {
             path_str(p) for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]
         }
+        offload = with_offload and self._offload_active()
+
+        def place(sharding: NamedSharding) -> NamedSharding:
+            # Scalars (step counts) stay on device: they gate control flow.
+            return sharding.with_memory_kind("pinned_host") if offload else sharding
 
         def assign(path, leaf):
             if not hasattr(leaf, "shape") or leaf.shape == ():
@@ -190,13 +227,13 @@ class ParallelPlan:
             parts = full.split("/")
             for start in range(len(parts)):
                 if "/".join(parts[start:]) in param_paths:
-                    return NamedSharding(
+                    return place(NamedSharding(
                         self.mesh, self._state_spec("/".join(parts[start:]), leaf.shape)
-                    )
+                    ))
             # non-param-mirroring leaves (EMA buffers etc.) follow the stage
             # gate too: stage 0 means *everything* in the state is replicated
             spec = self._maybe_fsdp(leaf.shape, P()) if self.zero_stage >= 1 else P()
-            return NamedSharding(self.mesh, spec)
+            return place(NamedSharding(self.mesh, spec))
 
         return jax.tree_util.tree_map_with_path(assign, state)
 

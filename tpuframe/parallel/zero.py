@@ -23,7 +23,7 @@ from typing import Any, Mapping, Sequence
 import jax
 from jax.sharding import Mesh
 
-from tpuframe.parallel.sharding import ParallelPlan, Rule
+from tpuframe.parallel.sharding import ParallelPlan, Rule, host_memory_available
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +55,7 @@ class ZeroConfig:
             zero_stage=self.stage,
             rules=tuple(rules),
             min_shard_elems=self.min_shard_elems,
+            offload_optimizer=self.offload_optimizer,
         )
 
 
@@ -79,6 +80,13 @@ def zero_3(mesh: Mesh, **kw) -> ParallelPlan:
     return ZeroConfig(stage=3).plan(mesh, **kw)
 
 
+def zero_3_offload(mesh: Mesh, **kw) -> ParallelPlan:
+    """Stage 3 + optimizer state in pinned host memory
+    (`deepspeed_config.py:87-105`); a no-op downgrade to plain stage 3 on
+    backends without a host memory space."""
+    return ZeroConfig(stage=3, offload_optimizer=True).plan(mesh, **kw)
+
+
 def host_offload_sharding(sharding: jax.sharding.Sharding) -> jax.sharding.Sharding:
     """The same sharding, placed in pinned host memory (stage-3 offload).
 
@@ -88,8 +96,4 @@ def host_offload_sharding(sharding: jax.sharding.Sharding) -> jax.sharding.Shard
 
 
 def supports_host_offload() -> bool:
-    try:
-        dev = jax.devices()[0]
-        return any(m.kind == "pinned_host" for m in dev.addressable_memories())
-    except Exception:  # pragma: no cover - backend-dependent
-        return False
+    return host_memory_available()

@@ -20,6 +20,15 @@ import optax
 from tpuframe.parallel.sharding import ParallelPlan
 
 
+def _any_host_resident(tree: Any) -> bool:
+    """True if any leaf's (traced or concrete) aval sits in host memory."""
+    for leaf in jax.tree.leaves(tree):
+        aval = getattr(leaf, "aval", None)
+        if getattr(aval, "memory_space", None) == jax.memory.Space.Host:
+            return True
+    return False
+
+
 class TrainState(flax.struct.PyTreeNode):
     """Params + optimizer state + mutable model collections + step counter.
 
@@ -37,7 +46,15 @@ class TrainState(flax.struct.PyTreeNode):
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
 
     def apply_gradients(self, grads: Any, **changes: Any) -> "TrainState":
-        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        opt_state = self.opt_state
+        if _any_host_resident(opt_state):
+            # ZeRO-3 CPU offload (`deepspeed_config.py:87-105`): the state
+            # lives in pinned host memory; stream it to HBM for the update.
+            # The step wrapper (make_train_step) moves the new state back.
+            opt_state = jax.tree.map(
+                lambda x: jax.device_put(x, jax.memory.Space.Device), opt_state
+            )
+        updates, new_opt_state = self.tx.update(grads, opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         return self.replace(
             step=self.step + 1,
@@ -93,9 +110,14 @@ def create_train_state(
         shardings = (
             plan.param_shardings(a_params),
             plan.param_shardings(a_stats),
-            plan.state_shardings(a_opt, a_params),
+            # memory kinds are illegal in jit out_shardings; offload moves
+            # the state to pinned host right after init
+            plan.state_shardings(a_opt, a_params, with_offload=False),
         )
         params, batch_stats, opt_state = jax.jit(init_fn, out_shardings=shardings)()
+        offloaded = plan.state_shardings(a_opt, a_params)
+        if offloaded != shardings[2]:
+            opt_state = jax.device_put(opt_state, offloaded)
         # Scalars must be *committed replicated* on the same mesh as the
         # params: a checkpoint restore reproduces the template's placement,
         # and a single-device committed step next to mesh-wide params is a
