@@ -20,8 +20,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
 
-from tpuframe.ops.dispatch import pad_to, use_pallas
+from tpuframe.ops.dispatch import batch_sharding_info, pad_to, resolve_interpret
 
 _ROWS = 16  # rows per grid step; sublane-aligned for f32/bf16
 _LANES = 128
@@ -120,17 +121,38 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
 def fused_cross_entropy(
-    logits: jax.Array, labels: jax.Array, interpret: bool | None = None
+    logits: jax.Array,
+    labels: jax.Array,
+    interpret: bool | None = None,
+    *,
+    mesh=None,
+    batch_axes: tuple = None,
 ) -> jax.Array:
     """Per-example softmax cross entropy, (B, K) logits + (B,) int labels.
 
     Differentiable w.r.t. logits via the recompute backward kernel.
     ``interpret``: None = auto (kernel on TPU, jnp oracle elsewhere).
+
+    ``mesh`` + ``batch_axes`` enable multi-chip use: the kernel runs
+    per batch shard under ``shard_map`` (rows are independent, so the
+    per-shard results concatenate to the exact global answer).  The
+    batch must divide evenly over the named axes; otherwise the jnp
+    reference path runs (which GSPMD shards natively).
     """
     if labels.ndim != 1:
         raise ValueError("fused_cross_entropy takes integer labels of shape (B,)")
+    axes, n_shards, shardable = batch_sharding_info(
+        mesh, batch_axes, logits.shape[0]
+    )
+    interpret = resolve_interpret(interpret, shardable)
     if interpret is None:
-        if not use_pallas():
-            return cross_entropy_reference(logits, labels)
-        interpret = False
+        return cross_entropy_reference(logits, labels)
+    if shardable and n_shards > 1:
+        return jax.shard_map(
+            lambda lg, lb: _fused(lg, lb, interpret),
+            mesh=mesh,
+            in_specs=(P(axes, None), P(axes)),
+            out_specs=P(axes),
+            check_vma=False,
+        )(logits, labels)
     return _fused(logits, labels, interpret)

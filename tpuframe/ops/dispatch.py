@@ -2,9 +2,20 @@
 
 Every op in tpuframe.ops has two implementations with identical
 semantics; tests assert they match (with ``interpret=True`` running the
-real kernel code on CPU).  ``TPUFRAME_DISABLE_PALLAS=1`` forces the
-reference path everywhere — the escape hatch when a kernel misbehaves
-on a new compiler version.
+real kernel code on CPU).  Env knobs:
+
+- ``TPUFRAME_DISABLE_PALLAS=1`` forces the reference path everywhere —
+  the escape hatch when a kernel misbehaves on a new compiler version.
+- ``TPUFRAME_PALLAS_INTERPRET=1`` runs the kernels in Pallas interpret
+  mode on any backend — how ``dryrun_multichip`` exercises the sharded
+  kernel paths on virtual CPU devices.
+
+Multi-chip: a ``pl.pallas_call`` lowers to a custom call the GSPMD
+partitioner cannot split, so ops invoke their kernels *per shard* under
+``jax.shard_map`` when the caller supplies a mesh (the pattern proven by
+``ops/ring_attention.py``).  Without a mesh, the kernel only engages in
+single-device processes; multi-device callers that don't pass a mesh get
+the jnp reference path, which XLA shards natively.
 """
 
 from __future__ import annotations
@@ -16,22 +27,69 @@ import jax
 _FALSY = {"", "0", "false", "no", "off"}
 
 
-def use_pallas() -> bool:
-    """True when compiled Pallas kernels should run.
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() not in _FALSY
 
-    Requires the TPU backend AND a single-device process:
-    ``pl.pallas_call`` lowers to a custom call the GSPMD partitioner
-    cannot split, so inside a multi-chip jit the kernel would force its
-    operands to replicate (an all-gather on the hot path).
 
-    ``TPUFRAME_DISABLE_PALLAS`` set to anything but a falsy value
-    ("", "0", "false", "no", "off") forces the reference path.
+def pallas_mode() -> str | None:
+    """How kernels should run: ``"compiled"`` | ``"interpret"`` | None.
+
+    ``None`` means use the jnp reference path.  Interpret mode wins over
+    the disable flag being absent on CPU so tests/dryruns can exercise
+    the real kernel code anywhere.
     """
-    if os.environ.get("TPUFRAME_DISABLE_PALLAS", "").strip().lower() not in _FALSY:
+    if _env_truthy("TPUFRAME_DISABLE_PALLAS"):
+        return None
+    if _env_truthy("TPUFRAME_PALLAS_INTERPRET"):
+        return "interpret"
+    if jax.default_backend() == "tpu":
+        return "compiled"
+    return None
+
+
+def use_pallas() -> bool:
+    """True when Pallas kernels run for a mesh-less (single-shard) call."""
+    mode = pallas_mode()
+    if mode is None:
         return False
-    if jax.default_backend() != "tpu":
-        return False
-    return jax.device_count() == 1
+    return mode == "interpret" or jax.device_count() == 1
+
+
+def resolve_interpret(interpret: bool | None, shardable: bool) -> bool | None:
+    """Shared op-level engage decision.
+
+    Returns the interpret flag to use, or None meaning "run the jnp
+    reference path".  An explicit ``interpret`` always wins.  Auto mode
+    engages the kernel when the backend compiles it (TPU) and either the
+    process is single-device or the caller can invoke it per-shard under
+    ``shard_map`` (``shardable``) — a bare pallas custom call inside a
+    multi-device jit would force operand replication.
+    """
+    if interpret is not None:
+        return interpret
+    mode = pallas_mode()
+    if mode is None:
+        return None
+    if mode == "compiled" and jax.device_count() > 1 and not shardable:
+        return None
+    return mode == "interpret"
+
+
+def batch_sharding_info(mesh, batch_axes, leading_size: int):
+    """-> (axes, n_shards, shardable) for sharding ``leading_size`` rows
+    over the ``batch_axes`` of ``mesh`` (mesh may be None)."""
+    if batch_axes is None:
+        from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS
+
+        batch_axes = (DATA_AXIS, FSDP_AXIS)
+    if mesh is None:
+        return (), 1, False
+    axes = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    shardable = bool(axes) and leading_size > 0 and leading_size % n == 0
+    return axes, n, shardable
 
 
 def pad_to(x: int, multiple: int) -> int:

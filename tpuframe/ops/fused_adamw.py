@@ -23,8 +23,9 @@ import jax.numpy as jnp
 import optax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
-from tpuframe.ops.dispatch import pad_to, use_pallas
+from tpuframe.ops.dispatch import pad_to, resolve_interpret
 
 _LANES = 128
 _TILE_ROWS = 256
@@ -65,6 +66,23 @@ def _kernel(t_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref, **hp):
     vo_ref[...] = v.astype(vo_ref.dtype)
 
 
+def _pallas_update(step2, fp, fg, fm, fv, hp, interpret):
+    """Run the kernel on (rows, _LANES)-shaped flats; step2 is (1, 1)."""
+    rows = fp.shape[0]
+    tile_rows = min(_TILE_ROWS, pad_to(rows, 8))
+    spec = pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))
+    scalar_spec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    out_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_kernel, **hp),
+        out_shape=(out_shape, out_shape, out_shape),
+        grid=(-(-rows // tile_rows),),
+        in_specs=[scalar_spec, spec, spec, spec, spec],
+        out_specs=(spec, spec, spec),
+        interpret=interpret,
+    )(step2, fp, fg, fm, fv)
+
+
 def fused_adamw_update(
     p: jax.Array,
     g: jax.Array,
@@ -78,28 +96,45 @@ def fused_adamw_update(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     interpret: bool | None = None,
+    mesh=None,
+    shard_axis: str | None = None,
 ):
-    """One-kernel AdamW for a single tensor; ``step`` is the 1-based count."""
+    """One-kernel AdamW for a single tensor; ``step`` is the 1-based count.
+
+    ``mesh`` + ``shard_axis`` (normally the ``fsdp`` axis — exactly where
+    ZeRO puts the optimizer state) run the kernel per row-shard of the
+    lane-flattened tensor under ``shard_map``: each device updates only
+    its slice of the moments, the comm pattern GSPMD builds around it
+    being ZeRO's reduce-scatter(grad) -> local update -> all-gather(param).
+    Leaves whose row count doesn't divide the axis fall back to the jnp
+    math, which XLA shards natively.
+    """
     hp = dict(lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
-    if interpret is None:
-        if not use_pallas():
-            t = step.astype(jnp.float32)
-            p_new, m_new, v_new = _update_math(
-                p.astype(jnp.float32), g.astype(jnp.float32),
-                m.astype(jnp.float32), v.astype(jnp.float32), t, **hp,
-            )
-            # Same dtype contract as the kernel path: params keep their
-            # dtype, moments are f32.
-            return p_new.astype(p.dtype), m_new, v_new
-        interpret = False
 
     shape, dtype = p.shape, p.dtype
     n = p.size
     # Lane-aligned leaves skip the host-side pad copy; Pallas clips the
     # ragged final row-tile itself.
     rows = n // _LANES if n % _LANES == 0 else -(-n // _LANES)
+    axis_size = (
+        mesh.shape[shard_axis]
+        if mesh is not None and shard_axis is not None and shard_axis in mesh.shape
+        else 1
+    )
+    shardable = axis_size > 1 and rows % axis_size == 0
+
+    interpret = resolve_interpret(interpret, shardable)
+    if interpret is None:
+        t = step.astype(jnp.float32)
+        p_new, m_new, v_new = _update_math(
+            p.astype(jnp.float32), g.astype(jnp.float32),
+            m.astype(jnp.float32), v.astype(jnp.float32), t, **hp,
+        )
+        # Same dtype contract as the kernel path: params keep their
+        # dtype, moments are f32.
+        return p_new.astype(p.dtype), m_new, v_new
+
     padded = rows * _LANES
-    tile_rows = min(_TILE_ROWS, pad_to(rows, 8))
 
     def flat(x):
         x = x.reshape(-1)
@@ -107,19 +142,19 @@ def fused_adamw_update(
             x = jnp.pad(x, (0, padded - n))
         return x.reshape(rows, _LANES)
 
-    spec = pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))
-    scalar_spec = pl.BlockSpec(
-        (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
-    )
-    out_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
-    po, mo, vo = pl.pallas_call(
-        functools.partial(_kernel, **hp),
-        out_shape=(out_shape, out_shape, out_shape),
-        grid=(-(-rows // tile_rows),),
-        in_specs=[scalar_spec, spec, spec, spec, spec],
-        out_specs=(spec, spec, spec),
-        interpret=interpret,
-    )(step.reshape(1, 1).astype(jnp.float32), flat(p), flat(g), flat(m), flat(v))
+    step2 = step.reshape(1, 1).astype(jnp.float32)
+    args = (step2, flat(p), flat(g), flat(m), flat(v))
+    if shardable:
+        spec2 = P(shard_axis, None)
+        po, mo, vo = jax.shard_map(
+            lambda s, a, b, c, d: _pallas_update(s, a, b, c, d, hp, interpret),
+            mesh=mesh,
+            in_specs=(P(None, None), spec2, spec2, spec2, spec2),
+            out_specs=(spec2, spec2, spec2),
+            check_vma=False,
+        )(*args)
+    else:
+        po, mo, vo = _pallas_update(*args, hp, interpret)
 
     def unflat(x, dt):
         return x.reshape(padded)[:n].reshape(shape).astype(dt)
@@ -139,16 +174,22 @@ def fused_adamw(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    mesh=None,
+    shard_axis: str | None = None,
 ) -> optax.GradientTransformation:
     """optax-compatible AdamW whose leaf updates run the fused kernel.
 
     ``update`` returns deltas (optax contract), computed as
-    ``p_new - p`` from the fused result.  The kernel engages only in
-    single-device contexts (``use_pallas``): a pallas custom call cannot
-    be split by the GSPMD partitioner, so under a multi-chip mesh (ZeRO
-    sharded state) every leaf routes to the jnp math, which XLA shards
-    and fuses natively — same results either way.
+    ``p_new - p`` from the fused result.  Pass ``mesh`` (and optionally
+    ``shard_axis``, default the ``fsdp`` axis) to run the kernel
+    per-shard under a multi-chip mesh — see :func:`fused_adamw_update`.
+    Without a mesh, multi-device processes route every leaf to the jnp
+    math, which XLA shards and fuses natively — same results either way.
     """
+    if mesh is not None and shard_axis is None:
+        from tpuframe.core.runtime import FSDP_AXIS
+
+        shard_axis = FSDP_AXIS
 
     def init(params):
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -174,7 +215,7 @@ def fused_adamw(
             fused_adamw_update(
                 p, g, m, v, step,
                 lr=learning_rate, b1=b1, b2=b2, eps=eps,
-                weight_decay=weight_decay,
+                weight_decay=weight_decay, mesh=mesh, shard_axis=shard_axis,
             )
             for p, g, m, v in zip(leaves_p, leaves_g, leaves_m, leaves_v)
         ]

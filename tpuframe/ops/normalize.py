@@ -19,8 +19,9 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
 
-from tpuframe.ops.dispatch import pad_to, use_pallas
+from tpuframe.ops.dispatch import batch_sharding_info, resolve_interpret
 
 _LANES = 128
 _TILE_ROWS = 256  # 256x128 f32 tile = 128 KiB of VMEM
@@ -99,11 +100,20 @@ def normalize_images(
     scale: float = 1.0 / 255.0,
     out_dtype=jnp.float32,
     interpret: bool | None = None,
+    *,
+    mesh=None,
+    batch_axes: tuple = None,
 ) -> jax.Array:
     """Fused ``(images * scale - mean) / std``; channels on the last axis.
 
     ``interpret``: None = auto (compiled kernel on TPU, jnp reference
     elsewhere); True = run the kernel in interpreter mode (tests).
+
+    ``mesh`` + ``batch_axes`` run the kernel per batch shard under
+    ``shard_map`` for multi-chip use.  Sharding splits the *leading*
+    dim (whole images per shard), so each shard's flattened stream
+    starts channel-aligned.  Falls back to the jnp reference when the
+    batch doesn't divide.
     """
     n_channels = images.shape[-1]
     mean = tuple(float(m) for m in mean)
@@ -112,13 +122,24 @@ def normalize_images(
         raise ValueError(
             f"mean/std length {len(mean)}/{len(std)} != channels {n_channels}"
         )
+    axes, n_shards, shardable = batch_sharding_info(
+        mesh, batch_axes, images.shape[0] if images.ndim >= 2 else 0
+    )
+    interpret = resolve_interpret(interpret, shardable)
     if interpret is None:
-        if not use_pallas():
-            return normalize_images_reference(images, mean, std, scale, out_dtype)
-        interpret = False
+        return normalize_images_reference(images, mean, std, scale, out_dtype)
     weights = tuple(scale / s for s in std)
     biases = tuple(-m / s for m, s in zip(mean, std))
-    out = _pallas_normalize(
-        images.reshape(-1), weights, biases, n_channels, out_dtype, interpret
-    )
-    return out.reshape(images.shape)
+
+    def run(x):
+        out = _pallas_normalize(
+            x.reshape(-1), weights, biases, n_channels, out_dtype, interpret
+        )
+        return out.reshape(x.shape)
+
+    if shardable and n_shards > 1:
+        spec = P(axes, *([None] * (images.ndim - 1)))
+        return jax.shard_map(
+            run, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+        )(images)
+    return run(images)

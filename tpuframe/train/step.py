@@ -28,21 +28,29 @@ from tpuframe.train.state import TrainState
 LossFn = Callable[[jax.Array, jax.Array], jax.Array]
 
 
-def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    mesh=None,
+    batch_axes: tuple | None = None,
+) -> jax.Array:
     """Integer-label softmax cross entropy (≈ reference's ``nll_loss`` after
     log_softmax, `01_basic_torch_distributor.py:90-92,226`).  Supports soft
     labels (N, C) for CutMix/LabelSmoothing mixtures.
 
-    (B,) integer labels route through the fused Pallas kernel on
-    single-chip TPU (recompute backward, no HBM softmax materialization);
-    multi-chip meshes and higher-rank integer labels keep the optax path
-    (a pallas custom call is opaque to the GSPMD partitioner)."""
+    (B,) integer labels route through the fused Pallas kernel on TPU
+    (recompute backward, no HBM softmax materialization) — per batch
+    shard under ``shard_map`` when ``mesh`` is given (the step factories
+    pass it from their ``plan``), single-chip directly.  Higher-rank
+    integer labels keep the optax path."""
     if labels.ndim == logits.ndim:
         return optax.softmax_cross_entropy(logits, labels)
     if labels.ndim == 1 and logits.ndim == 2:
         from tpuframe.ops import fused_cross_entropy
 
-        return fused_cross_entropy(logits, labels)
+        return fused_cross_entropy(
+            logits, labels, mesh=mesh, batch_axes=batch_axes
+        )
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
@@ -74,17 +82,57 @@ def _forward(state: TrainState, params: Any, batch: Mapping[str, jax.Array],
     return losses, logits, new_stats
 
 
+def _bind_loss(loss_fn: LossFn, plan: ParallelPlan | None) -> LossFn:
+    """Give the default loss its mesh so the fused CE kernel can run
+    per-shard on multi-chip meshes; custom losses pass through untouched."""
+    if plan is not None and loss_fn is cross_entropy:
+        return functools.partial(
+            cross_entropy, mesh=plan.mesh, batch_axes=tuple(plan.data_axes)
+        )
+    return loss_fn
+
+
+def _wrap_offload(jstep, plan: ParallelPlan | None):
+    """Return the new opt state to pinned host after each step when the
+    plan offloads it (jit outputs land on device; the put-back keeps the
+    steady-state HBM footprint at params+grads, not params+grads+moments)."""
+    if plan is None or not plan._offload_active():
+        return jstep
+    cache: dict[str, Any] = {}
+
+    def step(state, batch):
+        # Restore the *input* placement (pinned_host for offloaded leaves,
+        # device for scalars like the adamw count): step N+1 then has the
+        # exact sharding signature step N traced with — no recompile, and
+        # the step counter stays deviceside where it gates control flow.
+        if "sh" not in cache:
+            cache["sh"] = jax.tree.map(lambda x: x.sharding, state.opt_state)
+        new_state, metrics = jstep(state, batch)
+        return (
+            new_state.replace(
+                opt_state=jax.device_put(new_state.opt_state, cache["sh"])
+            ),
+            metrics,
+        )
+
+    return step
+
+
 def make_train_step(
     policy: Policy | None = None,
     loss_fn: LossFn = cross_entropy,
     donate: bool = True,
+    plan: ParallelPlan | None = None,
 ) -> Callable[[TrainState, Mapping[str, jax.Array]], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch) -> (state, metrics).
 
     Metrics are summed (loss_sum, correct, count) so they aggregate exactly
     across microbatches and hosts — the mean is taken by whoever logs.
+    ``plan`` (optional) lets the default cross-entropy run its Pallas
+    kernel per batch shard over the plan's mesh.
     """
     policy = policy or full_precision()
+    loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
         rng = state.step_rng("dropout")
@@ -109,12 +157,13 @@ def make_train_step(
         }
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return _wrap_offload(jax.jit(step, donate_argnums=(0,) if donate else ()), plan)
 
 
 def make_eval_step(
     policy: Policy | None = None,
     loss_fn: LossFn = cross_entropy,
+    plan: ParallelPlan | None = None,
 ) -> Callable[[TrainState, Mapping[str, jax.Array]], dict]:
     """Jitted eval step: (state, batch) -> summed metrics.
 
@@ -123,6 +172,7 @@ def make_eval_step(
     (the reference's rank-0-only eval sidesteps this by not distributing eval
     at all, `01_basic_torch_distributor.py:302-323`)."""
     policy = policy or full_precision()
+    loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
         losses, logits, _ = _forward(
@@ -169,6 +219,7 @@ def make_grad_accum_step(
     policy: Policy | None = None,
     loss_fn: LossFn = cross_entropy,
     donate: bool = True,
+    plan: ParallelPlan | None = None,
 ):
     """Gradient accumulation over leading-dim microbatches via ``lax.scan``.
 
@@ -178,6 +229,7 @@ def make_grad_accum_step(
     (`/root/reference/02_deepspeed/deepspeed_config.py:17`).
     """
     policy = policy or full_precision()
+    loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
         rng = state.step_rng("dropout")
@@ -220,7 +272,7 @@ def make_grad_accum_step(
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return _wrap_offload(jax.jit(step, donate_argnums=(0,) if donate else ()), plan)
 
 
 def merge_metrics(acc: dict | None, new: Mapping[str, jax.Array]) -> dict:
