@@ -23,6 +23,7 @@ from tpuframe.track.mlflow_store import (
     set_experiment,
     start_run,
 )
+from tpuframe.track.profiler import ProfilerCallback, StepTimer, trace, trace_step_window
 from tpuframe.track.system_metrics import SystemMetricsMonitor
 
 __all__ = [
@@ -33,4 +34,8 @@ __all__ = [
     "set_experiment",
     "start_run",
     "SystemMetricsMonitor",
+    "ProfilerCallback",
+    "StepTimer",
+    "trace",
+    "trace_step_window",
 ]

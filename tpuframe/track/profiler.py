@@ -1,0 +1,197 @@
+"""Profiling/tracing: programmatic ``jax.profiler`` capture + step timing.
+
+The TPU-native equivalent of the reference's tracing toolbox (SURVEY.md §5
+"Tracing / profiling"): DeepSpeed's ``wall_clock_breakdown: True`` +
+``steps_per_print`` (`/root/reference/02_deepspeed/deepspeed_config.py:47-48`),
+the CUDA debug env flags (`/root/reference/setup/00_setup.py:66-67,117-123`),
+and the ``nvidia-smi``/screenshot evidence (`/root/reference/README.md:18-20`)
+— replaced by real XLA traces:
+
+- :func:`trace` — context manager around any region; produces a TensorBoard-
+  loadable trace directory (per-op device timeline, HLO, memory viewer).
+- :class:`ProfilerCallback` — Trainer callback that captures steps
+  [skip_steps, skip_steps + num_steps) of the fit, then logs the zipped
+  trace as an artifact to the run (rank-0 only).
+
+Per-step wall-clock breakdown (data-wait vs dispatch vs host-block) is
+measured by the Trainer loop itself and reported in every epoch summary —
+see ``Trainer._run_epoch``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+import time
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tpuframe.train.trainer import Trainer
+
+from tpuframe.train.callbacks import Callback
+
+
+@contextlib.contextmanager
+def trace(logdir: str, host_tracer_level: int | None = None):
+    """Capture a ``jax.profiler`` trace of the enclosed region to ``logdir``.
+
+    The caller is responsible for blocking on async work it wants included
+    (``jax.block_until_ready``) before the region closes.
+    """
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def trace_step_window(fn, n_steps: int, logdir: str, *args, **kwargs) -> str:
+    """Run ``fn(*args, **kwargs)`` ``n_steps`` times under a trace.
+
+    ``fn``'s return value is blocked on each step so device work lands in
+    the trace.  Returns ``logdir``.
+    """
+    import jax
+
+    with trace(logdir):
+        for _ in range(n_steps):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+    return logdir
+
+
+class ProfilerCallback(Callback):
+    """Capture an XLA trace of a window of train steps, log it as an artifact.
+
+    Args:
+      logdir: where to write the trace (default: a temp dir, removed after
+        the artifact is logged).
+      skip_steps: batches to skip first (warmup/compile noise).
+      num_steps: batches to capture.
+    After capture, the trace directory is zipped and handed to every logger
+    exposing a ``run.log_artifact`` (tpuframe's MLflowLogger) or
+    ``log_artifact`` — rank-0 only, matching the logging discipline.
+    """
+
+    def __init__(
+        self,
+        logdir: str | None = None,
+        skip_steps: int = 3,
+        num_steps: int = 5,
+    ):
+        self.logdir = logdir
+        self.skip_steps = skip_steps
+        self.num_steps = num_steps
+        self._tmp: str | None = None
+        self._active = False
+        self._done = False
+        self.trace_dir: str | None = None
+        self.artifact: str | None = None
+
+    def _target(self) -> str:
+        if self.logdir is None and self._tmp is None:
+            self._tmp = tempfile.mkdtemp(prefix="tpuframe_trace_")
+        return self.logdir or self._tmp
+
+    def on_step_start(self, trainer: "Trainer") -> None:
+        if self._done or self._active or trainer.batches_seen < self.skip_steps:
+            return
+        import jax
+
+        target = self._target()
+        os.makedirs(target, exist_ok=True)
+        jax.profiler.start_trace(target)
+        self._active = True
+        self._start_batch = trainer.batches_seen
+
+    def on_step_end(self, trainer: "Trainer") -> None:
+        if not self._active:
+            return
+        if trainer.batches_seen - self._start_batch < self.num_steps:
+            return
+        import jax
+
+        jax.block_until_ready(trainer.state)
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        self.trace_dir = self._target()
+        if trainer.is_main:
+            self._log_artifact(trainer)
+        if self._tmp is not None:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None
+
+    def on_fit_end(self, trainer: "Trainer") -> None:
+        # fit ended mid-capture (duration reached / early stop): close the
+        # trace so the profiler isn't left running across fits.  The
+        # partial capture is discarded as done — a later fit must not mix
+        # a fresh session into the same directory.
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            if self._tmp is not None:
+                shutil.rmtree(self._tmp, ignore_errors=True)
+                self._tmp = None
+
+    def _log_artifact(self, trainer: "Trainer") -> None:
+        src = self._target()
+        base = os.path.join(
+            tempfile.mkdtemp(prefix="tpuframe_trace_zip_"), "xla_trace"
+        )
+        archive = shutil.make_archive(base, "zip", src)
+        for lg in trainer.loggers:
+            run = getattr(lg, "run", None)
+            target: Any = None
+            if run is not None and hasattr(run, "log_artifact"):
+                target = run
+            elif hasattr(lg, "log_artifact"):
+                target = lg
+            if target is not None:
+                self.artifact = target.log_artifact(archive, "profile")
+        shutil.rmtree(os.path.dirname(archive), ignore_errors=True)
+
+
+class StepTimer(Callback):
+    """Lightweight per-step wall-clock sampler (host side).
+
+    Records the host time of each dispatched step; ``summary()`` gives
+    mean/p50/p95 step wall time over the sampled window.  Complements the
+    Trainer's built-in data-wait/dispatch/block breakdown when you want
+    per-step distributions rather than epoch totals.
+    """
+
+    def __init__(self, max_samples: int = 4096):
+        self.max_samples = max_samples
+        self.samples: list[float] = []
+        self._t0: float | None = None
+
+    def on_step_start(self, trainer: "Trainer") -> None:
+        self._t0 = time.perf_counter()
+
+    def on_step_end(self, trainer: "Trainer") -> None:
+        if self._t0 is None:
+            return
+        if len(self.samples) < self.max_samples:
+            self.samples.append(time.perf_counter() - self._t0)
+        self._t0 = None
+
+    def summary(self) -> dict[str, float]:
+        if not self.samples:
+            return {}
+        s = sorted(self.samples)
+        n = len(s)
+        return {
+            "step_time_mean_s": sum(s) / n,
+            "step_time_p50_s": s[n // 2],
+            "step_time_p95_s": s[min(n - 1, int(n * 0.95))],
+            "steps_sampled": float(n),
+        }

@@ -19,6 +19,8 @@ class Callback:
 
     def on_fit_start(self, trainer: "Trainer") -> None: ...
     def on_epoch_start(self, trainer: "Trainer", epoch: int) -> None: ...
+    def on_step_start(self, trainer: "Trainer") -> None: ...
+    def on_step_end(self, trainer: "Trainer") -> None: ...
     def on_batch_end(self, trainer: "Trainer", metrics: dict) -> None: ...
     def on_epoch_end(self, trainer: "Trainer", epoch: int, metrics: dict) -> None: ...
     def on_eval_end(self, trainer: "Trainer", epoch: int, metrics: dict) -> None: ...

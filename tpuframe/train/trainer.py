@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -40,6 +41,7 @@ from tpuframe.train.state import TrainState, create_train_state
 from tpuframe.train.step import (
     cross_entropy,
     make_eval_step,
+    make_grad_accum_step,
     make_predict_fn,
     make_train_step,
     merge_metrics,
@@ -110,6 +112,7 @@ class Trainer:
         eval_interval: int = 1,
         log_interval: int = 10,
         report: Callable[[dict, str | None], None] | None = None,
+        grad_accum: int = 1,
     ):
         self.model = model
         self.train_dataloader = train_dataloader
@@ -154,8 +157,19 @@ class Trainer:
         self.samples_seen = 0
         self._stop_reason: str | None = None
 
-        self._train_step = make_train_step(self.policy, loss_fn)
-        self._eval_step = make_eval_step(self.policy, loss_fn)
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = grad_accum
+        if grad_accum > 1:
+            # DeepSpeed's gradient_accumulation_steps
+            # (`deepspeed_config.py:17`): host batches are reshaped to
+            # (n_micro, micro, ...) in _device_batches.
+            self._train_step = make_grad_accum_step(
+                grad_accum, self.policy, loss_fn, plan=self.plan
+            )
+        else:
+            self._train_step = make_train_step(self.policy, loss_fn, plan=self.plan)
+        self._eval_step = make_eval_step(self.policy, loss_fn, plan=self.plan)
         self._predict = make_predict_fn(self.policy)
 
     # -- wiring ------------------------------------------------------------
@@ -203,9 +217,25 @@ class Trainer:
     def _device_batches(self, loader: DataLoader, train: bool):
         """Host pipeline: algorithms -> dict batches -> prefetched global arrays."""
         algs = self.algorithms if train else []
+        accum = self.grad_accum if train else 1
         base_rng = np.random.default_rng(
             (self.seed * 1_000_003 + self.epoch) * 2 + int(train)
         )
+
+        def split_micro(x: np.ndarray) -> np.ndarray:
+            if x.shape[0] % accum:
+                raise ValueError(
+                    f"batch size {x.shape[0]} not divisible by "
+                    f"grad_accum={accum}"
+                )
+            micro = x.shape[0] // accum
+            if micro % self.plan.dp_size:
+                raise ValueError(
+                    f"microbatch size {micro} (batch {x.shape[0]} / "
+                    f"grad_accum={accum}) not divisible by the mesh's "
+                    f"{self.plan.dp_size} data-parallel shards"
+                )
+            return x.reshape((accum, micro) + x.shape[1:])
 
         def host_iter():
             for batch in loader:
@@ -215,9 +245,20 @@ class Trainer:
                 out = {"image": images, "label": labels}
                 if len(batch) > 2:
                     out["weight"] = np.asarray(batch[2], np.float32)
+                if accum > 1:
+                    out = {k: split_micro(v) for k, v in out.items()}
                 yield out
 
-        yield from DevicePrefetcher(host_iter(), sharding=self.plan.batch_sharding())
+        if accum > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # microbatch dim leads; the batch axes shard dim 1
+            sharding = NamedSharding(
+                self.plan.mesh, P(None, *self.plan.batch_spec())
+            )
+        else:
+            sharding = self.plan.batch_sharding()
+        yield from DevicePrefetcher(host_iter(), sharding=sharding)
 
     # -- the loop ----------------------------------------------------------
     def fit(self) -> FitResult:
@@ -263,7 +304,10 @@ class Trainer:
                 self._emit("on_epoch_end", self.epoch, epoch_summary)
 
                 ckpt_path = None
-                if self.checkpointer is not None and self.is_main_or_sharded and (
+                # Every process participates: orbax sharded saves are
+                # collective (rank-0-only discipline applies to *logging*,
+                # not checkpoint writes).
+                if self.checkpointer is not None and (
                     (self.epoch + 1) % self.checkpoint_interval == 0
                 ):
                     ckpt_path = self.checkpointer.save(
@@ -294,11 +338,6 @@ class Trainer:
                     lg.flush()
         return result
 
-    @property
-    def is_main_or_sharded(self) -> bool:
-        # Sharded checkpoints need every process to participate in save.
-        return True
-
     def _done(self) -> bool:
         return self.max_duration.reached(
             epoch=self.epoch, batch=self.batches_seen, samples=self.samples_seen
@@ -308,31 +347,69 @@ class Trainer:
         self._emit("on_epoch_start", self.epoch)
         self.train_dataloader.set_epoch(self.epoch)
         acc = None
-        window = None
+        window = None  # device-side metric pytree, materialized per interval
         t0 = time.perf_counter()
-        for batch in self._device_batches(self.train_dataloader, train=True):
+        # DeepSpeed-style wall-clock breakdown
+        # (`deepspeed_config.py:47-48`): where host time goes per epoch.
+        data_wait = dispatch = host_block = 0.0
+
+        def drain(window):
+            """Materialize the device-side window (the only host sync)."""
+            nonlocal host_block
+            tb = time.perf_counter()
+            out = {k: float(v) for k, v in window.items()}
+            host_block += time.perf_counter() - tb
+            return out
+
+        batches = iter(self._device_batches(self.train_dataloader, train=True))
+        while True:
+            td = time.perf_counter()
+            try:
+                batch = next(batches)
+            except StopIteration:
+                break
+            data_wait += time.perf_counter() - td
             if self._done() or self._stop_reason is not None:
                 break
+            self._emit("on_step_start")
+            ts = time.perf_counter()
             self.state, metrics = self._train_step(self.state, batch)
+            dispatch += time.perf_counter() - ts
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
-            window = merge_metrics(window, metrics)
+            # Accumulate on device (async) — floating every step would
+            # block the host on each step's completion and serialize the
+            # pipeline.
+            window = (
+                metrics
+                if window is None
+                else jax.tree.map(jnp.add, window, metrics)
+            )
+            self._emit("on_step_end")
             if self.log_interval and self.batches_seen % self.log_interval == 0:
-                acc = merge_metrics(acc, window) if window else acc
-                self._emit("on_batch_end", window)
+                w = drain(window)
+                acc = merge_metrics(acc, w)
+                self._emit("on_batch_end", w)
                 self._log_metrics(
-                    summarize_metrics(window, prefix="train_batch_"),
+                    summarize_metrics(w, prefix="train_batch_"),
                     step=self.batches_seen,
                 )
                 window = None
-        if window:
-            acc = merge_metrics(acc, window)
-            self._emit("on_batch_end", window)
+        if window is not None:
+            w = drain(window)
+            acc = merge_metrics(acc, w)
+            self._emit("on_batch_end", w)
         elapsed = time.perf_counter() - t0
         summary = summarize_metrics(acc or {}, prefix="train_")
         if acc:
-            summary["train_samples_per_sec"] = acc.get("count", 0.0) * rt.process_count() / max(elapsed, 1e-9)
+            # ``count`` comes from the jitted step over *global* arrays, so
+            # it is already the global sample count — no process factor
+            # (multiplying by process_count over-reported N x on pods).
+            summary["train_samples_per_sec"] = acc.get("count", 0.0) / max(elapsed, 1e-9)
         summary["epoch_time_s"] = elapsed
+        summary["data_wait_s"] = data_wait
+        summary["dispatch_s"] = dispatch
+        summary["host_block_s"] = host_block
         return summary
 
     def evaluate(self) -> dict[str, float]:
