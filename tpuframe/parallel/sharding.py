@@ -136,8 +136,13 @@ class ParallelPlan:
         axes = tuple(a for a in self.data_axes if self.axis_size(a) > 1)
         return P(axes) if axes else P()
 
-    def batch_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, self.batch_spec())
+    def batch_sharding(self, leading_microbatch: bool = False) -> NamedSharding:
+        """``leading_microbatch=True`` for (n_micro, micro, ...) grad-accum
+        batches: the microbatch dim leads, the batch axes shard dim 1."""
+        spec = self.batch_spec()
+        if leading_microbatch:
+            spec = P(None, *spec)
+        return NamedSharding(self.mesh, spec)
 
     # -- params ------------------------------------------------------------
     def _rule_spec(self, path: str) -> P | None:
@@ -245,9 +250,22 @@ class ParallelPlan:
         """Place a live param pytree according to the plan (host -> devices)."""
         return jax.device_put(params, self.param_shardings(params))
 
-    def shard_batch(self, batch: Any) -> Any:
-        sharding = self.batch_sharding()
-        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    def shard_batch(self, batch: Any, leading_microbatch: bool = False) -> Any:
+        """Host batch (this process's shard) -> global sharded Arrays.
+
+        Multi-process runs assemble the global array from per-process
+        locals via ``jax.make_array_from_process_local_data`` (each
+        process passes *different* rows — a plain device_put would
+        reject that); single-process is a straight device_put.
+        """
+        sharding = self.batch_sharding(leading_microbatch)
+        if jax.process_count() > 1:
+            put = lambda x: jax.make_array_from_process_local_data(  # noqa: E731
+                sharding, np.asarray(x)
+            )
+        else:
+            put = lambda x: jax.device_put(x, sharding)  # noqa: E731
+        return jax.tree.map(put, batch)
 
     def describe(self, params: Any) -> dict[str, str]:
         """Human-readable spec per param path (for logging/debugging)."""

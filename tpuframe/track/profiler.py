@@ -34,7 +34,7 @@ from tpuframe.train.callbacks import Callback
 
 
 @contextlib.contextmanager
-def trace(logdir: str, host_tracer_level: int | None = None):
+def trace(logdir: str):
     """Capture a ``jax.profiler`` trace of the enclosed region to ``logdir``.
 
     The caller is responsible for blocking on async work it wants included

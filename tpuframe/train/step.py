@@ -235,13 +235,17 @@ def make_grad_accum_step(
         rng = state.step_rng("dropout")
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
 
-        def micro(carry, mb):
+        def micro(carry, scanned):
+            mb, micro_idx = scanned
             grads_acc, stats, metrics = carry
+            # distinct dropout mask per microbatch — matching what the same
+            # samples would draw as separate steps
+            mb_rng = jax.random.fold_in(rng, micro_idx)
 
             def compute_loss(params):
                 losses, logits, new_stats = _forward(
                     state.replace(batch_stats=stats),
-                    params, mb, policy, True, rng, loss_fn,
+                    params, mb, policy, True, mb_rng, loss_fn,
                 )
                 return jnp.mean(losses), (logits, new_stats)
 
@@ -266,7 +270,9 @@ def make_grad_accum_step(
             "count": jnp.zeros(()),
         }
         (grads, new_stats, metrics), _ = jax.lax.scan(
-            micro, (zero_grads, state.batch_stats, init_metrics), batch
+            micro,
+            (zero_grads, state.batch_stats, init_metrics),
+            (batch, jnp.arange(n_microbatches)),
         )
         grads = jax.tree.map(lambda g: g / n_microbatches, grads)
         new_state = state.apply_gradients(grads, batch_stats=new_stats)

@@ -229,10 +229,14 @@ class Trainer:
                     f"grad_accum={accum}"
                 )
             micro = x.shape[0] // accum
-            if micro % self.plan.dp_size:
+            # x holds this process's rows; the dp check is on the *global*
+            # microbatch assembled across processes.
+            global_micro = micro * loader.process_count
+            if global_micro % self.plan.dp_size:
                 raise ValueError(
-                    f"microbatch size {micro} (batch {x.shape[0]} / "
-                    f"grad_accum={accum}) not divisible by the mesh's "
+                    f"global microbatch size {global_micro} (global batch "
+                    f"{x.shape[0] * loader.process_count} / grad_accum="
+                    f"{accum}) not divisible by the mesh's "
                     f"{self.plan.dp_size} data-parallel shards"
                 )
             return x.reshape((accum, micro) + x.shape[1:])
@@ -249,16 +253,10 @@ class Trainer:
                     out = {k: split_micro(v) for k, v in out.items()}
                 yield out
 
-        if accum > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            # microbatch dim leads; the batch axes shard dim 1
-            sharding = NamedSharding(
-                self.plan.mesh, P(None, *self.plan.batch_spec())
-            )
-        else:
-            sharding = self.plan.batch_sharding()
-        yield from DevicePrefetcher(host_iter(), sharding=sharding)
+        yield from DevicePrefetcher(
+            host_iter(),
+            sharding=self.plan.batch_sharding(leading_microbatch=accum > 1),
+        )
 
     # -- the loop ----------------------------------------------------------
     def fit(self) -> FitResult:
