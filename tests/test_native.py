@@ -1,0 +1,184 @@
+"""Native-layer tests: C++ zstd codec vs python-zstandard, and the C++
+control plane driven by real processes (rendezvous, barrier, broadcast,
+allgather, timeout, oversize, auth-token rejection)."""
+
+import multiprocessing as mp
+import os
+import socket
+
+import pytest
+
+from tpuframe.core.native import ControlPlane, ZstdCodec, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no g++/libzstd toolchain"
+)
+
+
+# ---------------------------------------------------------------------------
+# ZstdCodec
+# ---------------------------------------------------------------------------
+
+def _py_zstd():
+    import zstandard
+
+    return zstandard
+
+
+class TestZstdCodec:
+    def test_roundtrip_and_python_interop(self):
+        codec = ZstdCodec()
+        zstd = _py_zstd()
+        raw = os.urandom(1024) + b"compressible " * 5000
+        # C++ compress -> python decompress
+        blob = codec.compress(raw, level=3)
+        assert zstd.ZstdDecompressor().decompress(
+            blob, max_output_size=len(raw)
+        ) == raw
+        # python compress -> C++ decompress
+        pblob = zstd.ZstdCompressor(level=3).compress(raw)
+        assert codec.decompress(pblob, max_output_size=len(raw)) == raw
+
+    def test_batch_matches_singles_and_recovers_raw_size(self):
+        codec = ZstdCodec(n_threads=4)
+        raws = [b"x" * n for n in (0, 1, 1000, 1 << 16)]
+        blobs = [codec.compress(r) for r in raws]
+        # no raw_sizes given: sizes recovered from the frame header
+        out = codec.decompress_batch(blobs)
+        assert out == raws
+        # explicit raw_sizes path
+        out2 = codec.decompress_batch(blobs, [len(r) for r in raws])
+        assert out2 == raws
+        assert codec.decompress_batch([]) == []
+
+    def test_corrupt_frame_raises_with_index(self):
+        codec = ZstdCodec()
+        good = codec.compress(b"hello world" * 100)
+        with pytest.raises(RuntimeError, match="frame 1"):
+            codec.decompress_batch(
+                [good, b"\x00garbage\xff" * 4], [1100, 1100]
+            )
+
+    def test_unknown_content_size_needs_hint(self):
+        codec = ZstdCodec()
+        with pytest.raises(ValueError, match="unknown content size"):
+            codec.decompress_batch([b"\x00" * 4])
+
+
+# ---------------------------------------------------------------------------
+# ControlPlane — real multi-process collectives
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cp_worker(rank, world, port, token, q):
+    """Worker: rendezvous then run the op sequence; report results/errors."""
+    try:
+        cp = ControlPlane(
+            rank=rank, world=world, address="127.0.0.1", port=port,
+            timeout_ms=20_000, token=token,
+        )
+        cp.barrier()
+        run_id = cp.broadcast_str("run-abc123" if rank == 0 else None)
+        gathered = cp.allgather_bytes(f"host{rank}".encode())
+        cp.barrier()
+        cp.close()
+        q.put(("ok", rank, run_id, [g.decode() for g in gathered]))
+    except BaseException as e:  # pragma: no cover - failure reporting
+        q.put(("err", rank, repr(e), None))
+
+
+def _token_worker(rank, world, port, token, q):
+    try:
+        ControlPlane(
+            rank=rank, world=world, address="127.0.0.1", port=port,
+            timeout_ms=3_000, token=token,
+        )
+        q.put(("ok", rank, None, None))
+    except BaseException as e:
+        q.put(("err", rank, repr(e), None))
+
+
+def _spawn(target, args_list):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(*a, q)) for a in args_list]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+    return results
+
+
+class TestControlPlane:
+    def test_world1_is_noop(self):
+        cp = ControlPlane(rank=0, world=1)
+        cp.barrier()
+        assert cp.broadcast_str("abc") == "abc"
+        assert cp.allgather_bytes(b"x") == [b"x"]
+
+    def test_rendezvous_barrier_broadcast_allgather(self):
+        world, port = 3, _free_port()
+        results = _spawn(
+            _cp_worker, [(r, world, port, "tok") for r in range(world)]
+        )
+        assert all(r[0] == "ok" for r in results), results
+        for _, rank, run_id, gathered in results:
+            assert run_id == "run-abc123"
+            assert gathered == ["host0", "host1", "host2"]
+
+    def test_spoke_times_out_without_hub(self):
+        with pytest.raises(TimeoutError, match="rendezvous failed"):
+            ControlPlane(
+                rank=1, world=2, address="127.0.0.1", port=_free_port(),
+                timeout_ms=700,
+            )
+
+    def test_oversized_payload_rejected_before_send(self):
+        cp = ControlPlane(rank=0, world=1)
+        cp.world = 2  # simulate a multi-rank plane for the size check
+        with pytest.raises(ValueError, match="exceeds MAX_PAYLOAD"):
+            cp.broadcast_bytes(b"x" * (cp.MAX_PAYLOAD + 1))
+        with pytest.raises(ValueError, match="exceeds MAX_PAYLOAD"):
+            cp.allgather_bytes(b"x" * (cp.MAX_PAYLOAD + 1))
+
+    def test_wrong_token_cannot_join(self):
+        # hub expects "secret"; the spoke presents "wrong" and must not be
+        # admitted — the hub fails by timeout instead of a poisoned world.
+        world, port = 2, _free_port()
+        results = _spawn(
+            _token_worker,
+            [(0, world, port, "secret"), (1, world, port, "wrong")],
+        )
+        hub_result = next(r for r in results if r[1] == 0)
+        assert hub_result[0] == "err" and "TimeoutError" in hub_result[2]
+
+
+def _runid_worker(rank, world, port, q):
+    """End-to-end: the Distributor env contract drives broadcast_run_id
+    through the native control plane (no jax.distributed needed)."""
+    os.environ.update(
+        RANK=str(rank), WORLD_SIZE=str(world), MASTER_ADDR="127.0.0.1",
+        TPUFRAME_CP_PORT=str(port), TPUFRAME_CP_TOKEN="t",
+        TPUFRAME_NUM_PROCESSES=str(world), TPUFRAME_PROCESS_ID=str(rank),
+    )
+    try:
+        from tpuframe.core.native import control_plane
+
+        cp = control_plane()
+        out = cp.broadcast_str("mlflow-run-42" if rank == 0 else None)
+        q.put(("ok", rank, out, None))
+    except BaseException as e:  # pragma: no cover
+        q.put(("err", rank, repr(e), None))
+
+
+def test_run_id_broadcast_over_native_plane():
+    world, port = 2, _free_port()
+    results = _spawn(_runid_worker, [(r, world, port) for r in range(world)])
+    assert all(r[0] == "ok" for r in results), results
+    assert {r[2] for r in results} == {"mlflow-run-42"}

@@ -24,9 +24,11 @@ extern "C" {
 size_t tfs_compress_bound(size_t n) { return ZSTD_compressBound(n); }
 
 // Decompressed size recorded in a zstd frame header; 0 if unknown/error.
+// UINT64_MAX = unknown/error sentinel; 0 is a valid (empty) content size.
 uint64_t tfs_frame_content_size(const uint8_t* src, size_t src_size) {
   unsigned long long r = ZSTD_getFrameContentSize(src, src_size);
-  if (r == ZSTD_CONTENTSIZE_UNKNOWN || r == ZSTD_CONTENTSIZE_ERROR) return 0;
+  if (r == ZSTD_CONTENTSIZE_UNKNOWN || r == ZSTD_CONTENTSIZE_ERROR)
+    return UINT64_MAX;
   return (uint64_t)r;
 }
 

@@ -20,6 +20,8 @@
 #include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/time.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <cstdint>
@@ -31,6 +33,11 @@ namespace {
 constexpr uint8_t OP_BARRIER = 1;
 constexpr uint8_t OP_BROADCAST = 2;
 constexpr uint8_t OP_ALLGATHER = 3;
+
+// Hard ceiling on any frame: control payloads are capped at 1 MiB on the
+// Python side; the allgather blob concatenates one payload per rank.  An
+// attacker-supplied length beyond this is rejected before malloc.
+constexpr uint64_t MAX_FRAME = 1ull << 30;
 
 struct Plane {
   int world = 1;
@@ -68,12 +75,14 @@ bool send_frame(int fd, uint8_t op, const uint8_t* buf, uint64_t n) {
   return n == 0 || send_all(fd, buf, n);
 }
 
-// Receives into a malloc'd buffer (caller frees); checks the op tag.
+// Receives into a malloc'd buffer (caller frees); checks the op tag and
+// rejects frames beyond MAX_FRAME (no attacker-sized mallocs).
 bool recv_frame(int fd, uint8_t expect_op, uint8_t** buf, uint64_t* n) {
   uint8_t op;
   if (!recv_all(fd, &op, 1) || op != expect_op) return false;
   uint64_t len;
   if (!recv_all(fd, &len, 8)) return false;
+  if (len > MAX_FRAME) return false;
   uint8_t* p = (uint8_t*)malloc(len ? len : 1);
   if (!p) return false;
   if (len && !recv_all(fd, p, len)) {
@@ -90,14 +99,38 @@ void set_nodelay(int fd) {
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
+// Post-rendezvous receive timeout: a crashed peer makes ops fail instead
+// of blocking forever (the pre-fix behavior left spokes hung in recv).
+void set_rcvtimeo(int fd, int timeout_ms) {
+  if (timeout_ms <= 0) return;
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+// Collective ops wait much longer than the rendezvous: ranks legitimately
+// reach a barrier minutes apart (one rank checkpointing, say) and must not
+// be failed by the rendezvous-scale timeout.
+constexpr int OP_TIMEOUT_FACTOR = 10;
+
+int64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
 }  // namespace
 
 extern "C" {
 
-// Hub (rank 0): bind, accept world-1 connections (each sends its rank u32).
+// Hub (rank 0): bind, accept world-1 connections.  Each spoke introduces
+// itself with (rank u32, token u64); a token mismatch (stray/hostile
+// connection) drops that connection and keeps accepting — rendezvous only
+// fails when the timeout expires without all genuine spokes arriving.
 // Returns handle or nullptr.
 void* tfcp_hub_create(const char* bind_addr, int port, int world,
-                      int timeout_ms) {
+                      int timeout_ms, uint64_t token) {
   Plane* pl = new Plane;
   pl->world = world;
   pl->rank = 0;
@@ -117,19 +150,32 @@ void* tfcp_hub_create(const char* bind_addr, int port, int world,
     if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) goto fail;
     if (listen(fd, world) != 0) goto fail;
     pl->listen_fd = fd;
-    for (int i = 1; i < world; ++i) {
+    int joined = 0;
+    // absolute rendezvous deadline: stray connections (port scanners,
+    // health checks) must not re-arm the timeout
+    const int64_t deadline = now_ms() + timeout_ms;
+    while (joined < world - 1) {
+      int64_t remaining = deadline - now_ms();
+      if (remaining <= 0) goto fail;
       pollfd pfd{fd, POLLIN, 0};
-      if (poll(&pfd, 1, timeout_ms) <= 0) goto fail;
+      if (poll(&pfd, 1, (int)remaining) <= 0) goto fail;
       int cfd = accept(fd, nullptr, nullptr);
       if (cfd < 0) goto fail;
       set_nodelay(cfd);
+      // short handshake window so a silent stray can't stall acceptance
+      int hs = timeout_ms < 5000 ? timeout_ms : 5000;
+      set_rcvtimeo(cfd, hs);
       uint32_t peer_rank;
-      if (!recv_all(cfd, &peer_rank, 4) || peer_rank == 0 ||
-          (int)peer_rank >= world || pl->peers[peer_rank] != -1) {
-        close(cfd);
-        goto fail;
+      uint64_t peer_token;
+      if (!recv_all(cfd, &peer_rank, 4) || !recv_all(cfd, &peer_token, 8) ||
+          peer_token != token || peer_rank == 0 || (int)peer_rank >= world ||
+          pl->peers[peer_rank] != -1) {
+        close(cfd);  // stray or duplicate: reject, keep listening
+        continue;
       }
+      set_rcvtimeo(cfd, timeout_ms * OP_TIMEOUT_FACTOR);
       pl->peers[peer_rank] = cfd;
+      ++joined;
     }
   }
   return pl;
@@ -143,7 +189,7 @@ fail:
 
 // Spoke (rank > 0): connect to the hub, retrying until timeout.
 void* tfcp_spoke_create(const char* hub_addr, int port, int rank, int world,
-                        int timeout_ms) {
+                        int timeout_ms, uint64_t token) {
   Plane* pl = new Plane;
   pl->world = world;
   pl->rank = rank;
@@ -157,8 +203,9 @@ void* tfcp_spoke_create(const char* hub_addr, int port, int rank, int world,
     addr.sin_addr.s_addr = inet_addr(hub_addr);
     if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
       set_nodelay(fd);
+      set_rcvtimeo(fd, timeout_ms * OP_TIMEOUT_FACTOR);
       uint32_t r = (uint32_t)rank;
-      if (send_all(fd, &r, 4)) {
+      if (send_all(fd, &r, 4) && send_all(fd, &token, 8)) {
         pl->peers.push_back(fd);
         return pl;
       }

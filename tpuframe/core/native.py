@@ -120,17 +120,18 @@ class ZstdCodec:
         if n == 0:
             return []
         caps = []
+        unknown = (1 << 64) - 1  # codec.cpp's unknown/error sentinel
         for i, blob in enumerate(blobs):
             if raw_sizes is not None and raw_sizes[i]:
                 caps.append(int(raw_sizes[i]))
             else:
                 size = lib.tfs_frame_content_size(blob, len(blob))
-                if size == 0:
+                if size == unknown:
                     raise ValueError(f"frame {i}: unknown content size")
                 caps.append(int(size))
         src_arr = (ctypes.c_char_p * n)(*blobs)
         src_sizes = (ctypes.c_size_t * n)(*[len(b) for b in blobs])
-        outs = [ctypes.create_string_buffer(c) for c in caps]
+        outs = [ctypes.create_string_buffer(max(1, c)) for c in caps]
         dst_arr = (ctypes.c_void_p * n)(*[ctypes.addressof(o) for o in outs])
         dst_caps = (ctypes.c_size_t * n)(*caps)
         dst_sizes = (ctypes.c_size_t * n)()
@@ -163,6 +164,7 @@ class ControlPlane:
         address: str | None = None,
         port: int | None = None,
         timeout_ms: int = 60_000,
+        token: str | None = None,
     ):
         rank = int(os.environ.get("RANK", 0)) if rank is None else rank
         world = int(os.environ.get("WORLD_SIZE", 1)) if world is None else world
@@ -170,6 +172,17 @@ class ControlPlane:
             address = os.environ.get("MASTER_ADDR", "127.0.0.1")
         if port is None:
             port = int(os.environ.get("TPUFRAME_CP_PORT", "29401"))
+        if token is None:
+            token = os.environ.get("TPUFRAME_CP_TOKEN", "")
+        # shared-token handshake: strangers that don't know the token can't
+        # claim a rank slot (ADVICE r01); empty token -> 0, c10d-style trust
+        token_u64 = (
+            int.from_bytes(
+                hashlib.sha256(token.encode()).digest()[:8], "little"
+            )
+            if token
+            else 0
+        )
         self.rank, self.world = rank, world
         self._h = None
         self._lib = None
@@ -180,11 +193,12 @@ class ControlPlane:
             if not getattr(lib, "_tf_sigs", False):
                 lib.tfcp_hub_create.restype = ctypes.c_void_p
                 lib.tfcp_hub_create.argtypes = [
-                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_uint64]
                 lib.tfcp_spoke_create.restype = ctypes.c_void_p
                 lib.tfcp_spoke_create.argtypes = [
                     ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                    ctypes.c_int]
+                    ctypes.c_int, ctypes.c_uint64]
                 lib.tfcp_barrier.argtypes = [ctypes.c_void_p]
                 lib.tfcp_broadcast.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p,
@@ -197,10 +211,13 @@ class ControlPlane:
                 lib._tf_sigs = True
             self._lib = lib
             if rank == 0:
-                self._h = lib.tfcp_hub_create(b"", port, world, timeout_ms)
+                bind = os.environ.get("TPUFRAME_CP_BIND", "")
+                self._h = lib.tfcp_hub_create(
+                    bind.encode(), port, world, timeout_ms, token_u64
+                )
             else:
                 self._h = lib.tfcp_spoke_create(
-                    address.encode(), port, rank, world, timeout_ms
+                    address.encode(), port, rank, world, timeout_ms, token_u64
                 )
             if not self._h:
                 raise TimeoutError(
@@ -217,6 +234,15 @@ class ControlPlane:
     def broadcast_bytes(self, payload: bytes | None) -> bytes:
         if self.world == 1:
             return payload or b""
+        if payload is not None and len(payload) > self.MAX_PAYLOAD:
+            # fail loudly on every rank path that can know (ADVICE r01:
+            # an oversized rank-0 payload used to raise mid-protocol and
+            # leave spokes blocked; the SO_RCVTIMEO backstop now also
+            # bounds any peer left waiting)
+            raise ValueError(
+                f"control-plane payload {len(payload)} bytes exceeds "
+                f"MAX_PAYLOAD={self.MAX_PAYLOAD}"
+            )
         buf = ctypes.create_string_buffer(self.MAX_PAYLOAD)
         size = ctypes.c_uint64(0)
         if self.rank == 0:
@@ -234,6 +260,14 @@ class ControlPlane:
     def allgather_bytes(self, payload: bytes) -> list[bytes]:
         if self.world == 1:
             return [payload]
+        # per-rank bound only: payload sizes may differ across ranks, so a
+        # total-size guess here would raise on some ranks and not others.
+        # The hub enforces the true total against MAX_PAYLOAD (rc=-2).
+        if len(payload) > self.MAX_PAYLOAD:
+            raise ValueError(
+                f"allgather payload {len(payload)} bytes exceeds "
+                f"MAX_PAYLOAD={self.MAX_PAYLOAD}"
+            )
         out = ctypes.create_string_buffer(self.MAX_PAYLOAD)
         sizes = (ctypes.c_uint64 * self.world)()
         rc = self._lib.tfcp_allgather(
@@ -257,3 +291,17 @@ class ControlPlane:
 
     def __exit__(self, *exc):
         self.close()
+
+
+_CONTROL_PLANE: ControlPlane | None = None
+
+
+def control_plane() -> ControlPlane:
+    """Process-wide ControlPlane built from the torchrun-style env contract
+    (RANK/WORLD_SIZE/MASTER_ADDR + TPUFRAME_CP_PORT/TOKEN, injected by the
+    Distributor).  Created on first use; all ranks must make the same
+    sequence of collective calls on it."""
+    global _CONTROL_PLANE
+    if _CONTROL_PLANE is None:
+        _CONTROL_PLANE = ControlPlane()
+    return _CONTROL_PLANE

@@ -127,6 +127,10 @@ class Distributor:
         )
         if self.num_processes > 1:
             env["TPUFRAME_COORDINATOR"] = f"127.0.0.1:{port}"
+            # distinct port + run-scoped token for the host control plane
+            # (run-id broadcast etc.) so two jobs on one host can't cross
+            env["TPUFRAME_CP_PORT"] = str(self._cp_port)
+            env.setdefault("TPUFRAME_CP_TOKEN", f"tpuframe-{port}")
         if self.simulate_devices:
             env["JAX_PLATFORMS"] = "cpu"
             # An image sitecustomize may force-register a TPU plugin that
@@ -158,6 +162,7 @@ class Distributor:
         result (must be picklable, same constraint as the reference's
         ``return "finished"`` convention, `01_basic_torch_distributor.py:328`)."""
         port = self.master_port or self._free_port()
+        self._cp_port = self._free_port()
         with tempfile.TemporaryDirectory(prefix="tpuframe_launch_") as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             with open(payload, "wb") as f:
