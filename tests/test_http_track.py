@@ -1,0 +1,197 @@
+"""Remote-tracking adapter tests against a mocked in-process MLflow server."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tpuframe.track import MLflowLogger, make_tracker
+from tpuframe.track.http_store import HttpError, HttpExperimentTracker
+
+
+class MockMlflow(BaseHTTPRequestHandler):
+    """Minimal MLflow REST 2.0 server: experiments, runs, artifact proxy."""
+
+    store = None  # set per-instance via server attribute
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def _json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+
+    def do_GET(self):
+        s = self.server.store
+        if self.path.startswith("/api/2.0/mlflow/experiments/get-by-name"):
+            import urllib.parse
+
+            q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+            name = q["experiment_name"][0]
+            for eid, ename in s["experiments"].items():
+                if ename == name:
+                    self._json(200, {"experiment": {
+                        "experiment_id": eid, "name": ename}})
+                    return
+            self._json(404, {"error_code": "RESOURCE_DOES_NOT_EXIST"})
+            return
+        self._json(404, {"error_code": "ENDPOINT_NOT_FOUND"})
+
+    def do_POST(self):
+        s = self.server.store
+        payload = json.loads(self._body() or b"{}")
+        s["auth"].append(self.headers.get("Authorization"))
+        if self.path.endswith("/experiments/create"):
+            eid = str(len(s["experiments"]))
+            s["experiments"][eid] = payload["name"]
+            self._json(200, {"experiment_id": eid})
+        elif self.path.endswith("/runs/create"):
+            rid = f"r{len(s['runs'])}"
+            s["runs"][rid] = {"params": {}, "metrics": [], "tags": {},
+                              "status": "RUNNING"}
+            self._json(200, {"run": {"info": {
+                "run_id": rid, "run_name": payload.get("run_name", "")}}})
+        elif self.path.endswith("/runs/log-batch"):
+            run = s["runs"][payload["run_id"]]
+            for p in payload.get("params", []):
+                run["params"][p["key"]] = p["value"]
+            run["metrics"].extend(payload.get("metrics", []))
+            s["batch_sizes"].append(
+                len(payload.get("params", [])) + len(payload.get("metrics", []))
+            )
+            self._json(200, {})
+        elif self.path.endswith("/runs/set-tag"):
+            s["runs"][payload["run_id"]]["tags"][payload["key"]] = payload["value"]
+            self._json(200, {})
+        elif self.path.endswith("/runs/update"):
+            s["runs"][payload["run_id"]]["status"] = payload["status"]
+            self._json(200, {})
+        else:
+            self._json(404, {"error_code": "ENDPOINT_NOT_FOUND"})
+
+    def do_PUT(self):
+        s = self.server.store
+        if self.path.startswith("/api/2.0/mlflow-artifacts/") and s["artifacts_on"]:
+            s["artifacts"][self.path] = self._body()
+            self._json(200, {})
+        else:
+            self._json(404, {"error_code": "ENDPOINT_NOT_FOUND"})
+
+
+@pytest.fixture()
+def mock_server():
+    server = HTTPServer(("127.0.0.1", 0), MockMlflow)
+    server.store = {
+        "experiments": {}, "runs": {}, "artifacts": {}, "auth": [],
+        "batch_sizes": [], "artifacts_on": True,
+    }
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def _uri(server):
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def test_experiment_get_or_create_and_run_lifecycle(mock_server):
+    tracker = make_tracker(_uri(mock_server))
+    assert isinstance(tracker, HttpExperimentTracker)
+    eid = tracker.set_experiment("remote-exp")
+    # idempotent second set_experiment reuses the id
+    assert tracker.set_experiment("remote-exp") == eid
+
+    with tracker.start_run(run_name="trial") as run:
+        run.log_params({"lr": 0.001, "bs": 64})
+        run.log_metrics({"loss": 1.5, "acc": 0.5}, step=0)
+        run.log_metric("loss", 1.0, step=1)
+        run.set_tag("framework", "tpuframe")
+    store = mock_server.store
+    rec = store["runs"][run.run_id]
+    assert rec["params"] == {"lr": "0.001", "bs": "64"}
+    assert [m["key"] for m in rec["metrics"]] == ["loss", "acc", "loss"]
+    assert rec["metrics"][2]["step"] == 1
+    assert rec["tags"]["framework"] == "tpuframe"
+    assert rec["status"] == "FINISHED"
+
+
+def test_failed_status_on_exception(mock_server):
+    tracker = HttpExperimentTracker(_uri(mock_server))
+    tracker.set_experiment("e")
+    with pytest.raises(RuntimeError, match="boom"):
+        with tracker.start_run() as run:
+            raise RuntimeError("boom")
+    assert mock_server.store["runs"][run.run_id]["status"] == "FAILED"
+
+
+def test_artifact_upload_and_graceful_skip(mock_server, tmp_path):
+    tracker = HttpExperimentTracker(_uri(mock_server))
+    tracker.set_experiment("e")
+    run = tracker.start_run()
+    f = tmp_path / "note.txt"
+    f.write_text("hello")
+    run.log_artifact(str(f), "docs")
+    assert any(
+        p.endswith(f"{run.run_id}/artifacts/docs/note.txt")
+        for p in mock_server.store["artifacts"]
+    )
+    # server without the artifact proxy: skip + tag, not a crash
+    mock_server.store["artifacts_on"] = False
+    run.log_artifact(str(f), "docs2")
+    assert (
+        mock_server.store["runs"][run.run_id]["tags"]["tpuframe.artifact_skipped"]
+        == "docs2/note.txt"
+    )
+
+
+def test_log_batch_splits_oversized_payloads(mock_server):
+    tracker = HttpExperimentTracker(_uri(mock_server))
+    tracker.set_experiment("e")
+    run = tracker.start_run()
+    run.log_metrics({f"m{i}": float(i) for i in range(2000)}, step=0)
+    sizes = mock_server.store["batch_sizes"]
+    assert sum(sizes) == 2000 and max(sizes) <= run.METRIC_BATCH
+    # params have a much lower server-side cap (100/request)
+    mock_server.store["batch_sizes"] = []
+    run.log_params({f"p{i}": i for i in range(250)})
+    sizes = mock_server.store["batch_sizes"]
+    assert sum(sizes) == 250 and max(sizes) <= run.PARAM_BATCH
+
+
+def test_bearer_auth_from_env(mock_server, monkeypatch):
+    monkeypatch.setenv("MLFLOW_TRACKING_TOKEN", "sekret")
+    tracker = HttpExperimentTracker(_uri(mock_server))
+    tracker.set_experiment("e")
+    tracker.start_run()
+    assert "Bearer sekret" in mock_server.store["auth"]
+
+
+def test_mlflow_logger_routes_by_scheme(mock_server):
+    # the Trainer-facing logger transparently talks to the remote server
+    logger = MLflowLogger("exp-via-logger", tracking_uri=_uri(mock_server))
+    logger.log_params({"a": 1})
+    logger.log_metrics({"loss": 0.25}, step=3)
+    logger.finish()
+    store = mock_server.store
+    assert "exp-via-logger" in store["experiments"].values()
+    (rec,) = store["runs"].values()
+    assert rec["params"] == {"a": "1"}
+    assert rec["metrics"][0]["value"] == 0.25
+    assert rec["status"] == "FINISHED"
+
+
+def test_http_error_surfaces_status(mock_server):
+    tracker = HttpExperimentTracker(_uri(mock_server))
+    with pytest.raises(HttpError, match="404") as exc:
+        tracker._client.call("GET", "/api/2.0/mlflow/bogus-endpoint")
+    assert exc.value.status == 404

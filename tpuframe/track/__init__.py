@@ -23,6 +23,7 @@ from tpuframe.track.mlflow_store import (
     set_experiment,
     start_run,
 )
+from tpuframe.track.http_store import HttpExperimentTracker, HttpRun, make_tracker
 from tpuframe.track.profiler import ProfilerCallback, StepTimer, trace, trace_step_window
 from tpuframe.track.system_metrics import SystemMetricsMonitor
 
@@ -34,6 +35,9 @@ __all__ = [
     "set_experiment",
     "start_run",
     "SystemMetricsMonitor",
+    "HttpExperimentTracker",
+    "HttpRun",
+    "make_tracker",
     "ProfilerCallback",
     "StepTimer",
     "trace",

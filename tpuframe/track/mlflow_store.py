@@ -357,7 +357,9 @@ class MLflowLogger:
     @property
     def run(self) -> Run:
         if self._run is None:
-            self._tracker = ExperimentTracker(self.tracking_uri)
+            from tpuframe.track.http_store import make_tracker
+
+            self._tracker = make_tracker(self.tracking_uri)
             self._tracker.set_experiment(self.experiment_name)
             self._run = self._tracker.start_run(run_name=self.run_name)
             if self.system_metrics:
@@ -395,9 +397,13 @@ class MLflowLogger:
 _DEFAULT_TRACKER: ExperimentTracker | None = None
 
 
-def set_experiment(name: str, tracking_uri: str = "./mlruns") -> ExperimentTracker:
+def set_experiment(name: str, tracking_uri: str = "./mlruns"):
+    """File store for local paths, REST client for http(s) tracking URIs
+    (the reference's remote-server path, `setup/00_setup.py:86-101`)."""
+    from tpuframe.track.http_store import make_tracker
+
     global _DEFAULT_TRACKER
-    _DEFAULT_TRACKER = ExperimentTracker(tracking_uri)
+    _DEFAULT_TRACKER = make_tracker(tracking_uri)
     _DEFAULT_TRACKER.set_experiment(name)
     return _DEFAULT_TRACKER
 
@@ -409,19 +415,35 @@ def start_run(run_name: str | None = None) -> Run:
 
 
 def broadcast_run_id(run_id: str | None, max_len: int = 64) -> str:
-    """Propagate rank 0's run id to every process over the jax control plane.
+    """Propagate rank 0's run id to every process.
 
     Replaces the reference's char-tensor NCCL broadcast
-    (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`): here
-    the string rides ``broadcast_one_to_all`` (a compiled host-data broadcast),
-    no manual chr/ord packing.  Call on ALL processes; pass the real id on
-    process 0 and anything (e.g. None) elsewhere.
+    (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-18`).
+    Primary path: the C++ host control plane (tpuframe.core.native) — a
+    tiny control string should not require compiling an XLA program, and
+    it works before/without jax.distributed.  Falls back to jax's
+    ``broadcast_one_to_all`` when the native plane is unavailable.
+    Call on ALL processes; pass the real id on process 0 and anything
+    (e.g. None) elsewhere.
     """
+    if rt.process_count() == 1:
+        return run_id or ""
+
+    import os
+
+    if int(os.environ.get("WORLD_SIZE", "1")) == rt.process_count():
+        try:
+            from tpuframe.core.native import control_plane
+
+            return control_plane().broadcast_str(
+                run_id if rt.is_main_process() else None
+            )
+        except Exception:
+            pass  # no toolchain / env contract mismatch: use the jax path
+
     import numpy as np
     from jax.experimental import multihost_utils
 
-    if rt.process_count() == 1:
-        return run_id or ""
     buf = np.zeros(max_len, np.uint8)
     if rt.is_main_process() and run_id:
         raw = run_id.encode()[:max_len]
