@@ -19,6 +19,12 @@ from tpuframe.parallel.sharding import (
     infer_shard_dim,
     path_str,
 )
+from tpuframe.parallel.pipeline import (
+    PipelinedTransformerLM,
+    gpipe_spmd,
+    pipeline_param_spec,
+    stack_stage_params,
+)
 from tpuframe.parallel.zero import (
     ZeroConfig,
     host_offload_sharding,
@@ -31,6 +37,10 @@ from tpuframe.parallel.zero import (
 )
 
 __all__ = [
+    "PipelinedTransformerLM",
+    "gpipe_spmd",
+    "pipeline_param_spec",
+    "stack_stage_params",
     "Policy",
     "bf16_compute",
     "full_precision",

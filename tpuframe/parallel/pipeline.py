@@ -1,0 +1,257 @@
+"""Pipeline parallelism: SPMD GPipe over the ``pipe`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.2 marks PP "No"), but a
+first-class tpuframe axis.  TPU-native design — no per-stage processes,
+no send/recv graphs: every device runs the SAME program under
+``shard_map``; stage identity is ``lax.axis_index('pipe')``, stage
+weights are the slice of a layer-stacked parameter pytree sharded over
+``pipe``, and activations hop stage->stage with ``lax.ppermute``
+(nearest-neighbour ICI transfers).  The schedule is GPipe: M microbatches
+fill the S-deep pipeline over M+S-1 ticks; reverse-mode AD through the
+``lax.scan`` of ticks gives the backward pipeline automatically.
+
+Bubble fraction is (S-1)/(M+S-1) — choose ``n_microbatches >> stages``.
+
+Two layers of API:
+
+- :func:`gpipe_spmd` — the schedule primitive: (stage_fn, stacked params,
+  (M, micro, ...) batch) -> (M, micro, ...) outputs.
+- :class:`PipelinedTransformerLM` — a drop-in LM whose blocks run under
+  the schedule (same math as ``TransformerLM`` with equal weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS
+
+
+def gpipe_spmd(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh,
+    axis: str = PIPELINE_AXIS,
+    batch_axes: tuple = (DATA_AXIS, FSDP_AXIS),
+) -> jax.Array:
+    """Run ``stage_fn`` as an S-stage GPipe pipeline over ``mesh[axis]``.
+
+    Args:
+      stage_fn: ``(params_s, y) -> y`` — one stage's computation; every
+        stage must preserve the activation shape (transformer blocks do).
+      stage_params: pytree whose leaves are stacked on a leading stage dim
+        of size S = ``mesh.shape[axis]`` (sharded or shardable over it).
+      x: microbatched input ``(M, micro, ...)``; ``M >= S`` required.
+      batch_axes: mesh axes sharding the micro dim (dim 1).
+
+    Returns ``(M, micro, ...)`` outputs, numerically identical to applying
+    stages 0..S-1 sequentially to each microbatch.
+    """
+    n_stages = mesh.shape[axis] if axis in mesh.shape else 1
+    if n_stages == 1:
+        def seq(params, y):
+            for s in range(jax.tree.leaves(stage_params)[0].shape[0]):
+                y = stage_fn(jax.tree.map(lambda a: a[s], params), y)
+            return y
+
+        return jax.vmap(lambda mb: seq(stage_params, mb))(x)
+
+    n_micro = x.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"n_microbatches ({n_micro}) must be >= pipeline stages "
+            f"({n_stages}); the pipeline can't even fill"
+        )
+
+    data_axes = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
+    x_spec = P(None, data_axes if data_axes else None, *([None] * (x.ndim - 2)))
+    param_spec = jax.tree.map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stage_params
+    )
+
+    def local(params_local, x_local):
+        # params_local: this stage's slice, leading dim 1
+        p = jax.tree.map(lambda a: a[0], params_local)
+        s = lax.axis_index(axis)
+        last = n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        state = jnp.zeros_like(x_local[0])  # activation entering this stage
+        outputs = jnp.zeros_like(x_local)   # filled on the last stage
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t while t < M; later ticks drain
+            feed = x_local[jnp.clip(t, 0, n_micro - 1)]
+            y_in = jnp.where(s == 0, feed, state)
+            y_out = stage_fn(p, y_in)
+            # the last stage completes microbatch t-(S-1) at tick t
+            done = t - last
+            updated = lax.dynamic_update_index_in_dim(
+                outputs, y_out, jnp.clip(done, 0, n_micro - 1), 0
+            )
+            outputs = jnp.where((s == last) & (done >= 0), updated, outputs)
+            # hop: stage i's output becomes stage i+1's next input
+            state = lax.ppermute(y_out, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(n_micro + n_stages - 1)
+        )
+        # outputs are only genuine on the last stage; psum replicates them
+        # (every other stage contributes zeros)
+        return lax.psum(jnp.where(s == last, outputs, 0.0), axis)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)
+
+
+def stack_stage_params(per_stage: list) -> Any:
+    """[stage0_params, stage1_params, ...] -> one pytree with a leading
+    stage dim (what :func:`gpipe_spmd` consumes)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage)
+
+
+def pipeline_param_spec(stage_params: Any, axis: str = PIPELINE_AXIS) -> Any:
+    """PartitionSpec pytree placing the stage dim on the pipe axis."""
+    return jax.tree.map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stage_params
+    )
+
+
+@dataclasses.dataclass
+class PipelinedTransformerLM:
+    """Decoder LM with its blocks executed as a GPipe pipeline.
+
+    Same math as :class:`tpuframe.models.TransformerLM` (pre-norm blocks,
+    learned positions, weight-untied head) with layers grouped into
+    ``mesh.shape['pipe']`` stages.  Duck-types the flax ``init``/``apply``
+    contract so ``create_train_state``/``make_train_step`` work unchanged;
+    the batch enters as ``(B, L)`` and is internally split into
+    ``n_microbatches`` along B.
+
+    num_layers must be divisible by the stage count; B by n_microbatches.
+    """
+
+    vocab_size: int
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 32
+    max_len: int = 2048
+    mlp_ratio: int = 4
+    n_microbatches: int = 4
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        import flax.linen as nn
+
+        d_model = self.num_heads * self.head_dim
+
+        class EmbedHead(nn.Module):
+            vocab: int
+            max_len: int
+            d: int
+            dtype: Any
+
+            def setup(self):
+                self.embed = nn.Embed(self.vocab, self.d, dtype=self.dtype)
+                self.pos_embed = nn.Embed(self.max_len, self.d, dtype=self.dtype)
+                self.ln_f = nn.LayerNorm(dtype=self.dtype)
+                self.lm_head = nn.Dense(
+                    self.vocab, use_bias=False, dtype=self.dtype
+                )
+
+            def __call__(self, tokens):
+                x = self.embed(tokens)
+                return x + self.pos_embed(jnp.arange(tokens.shape[1])[None, :])
+
+            def head(self, x):
+                return self.lm_head(self.ln_f(x)).astype(jnp.float32)
+
+        from tpuframe.models.transformer import Block
+
+        self._embed_head = EmbedHead(
+            vocab=self.vocab_size, max_len=self.max_len, d=d_model, dtype=self.dtype
+        )
+        # one Block module reused for every layer; per-layer weights come
+        # from the stacked params (attention stays the XLA full path —
+        # ring attention composes with PP via the seq axis inside blocks)
+        self._block = Block(
+            self.num_heads, self.head_dim, mlp_ratio=self.mlp_ratio,
+            causal=True, attn_impl="full", dtype=self.dtype,
+        )
+
+    # -- flax-like contract -------------------------------------------------
+    def init(self, rngs, tokens, train: bool = False):
+        params_rng = rngs["params"] if isinstance(rngs, dict) else rngs
+        eh = self._embed_head.init(params_rng, tokens)["params"]
+        # head params initialize lazily via init-with-method
+        head_vars = self._embed_head.init(
+            params_rng, jnp.zeros(
+                (1, tokens.shape[1], self.num_heads * self.head_dim), self.dtype
+            ),
+            method=self._embed_head.head,
+        )["params"]
+        eh = {**eh, **head_vars}
+        d_model = self.num_heads * self.head_dim
+        sample = jnp.zeros((1, tokens.shape[1], d_model), self.dtype)
+        keys = jax.random.split(params_rng, self.num_layers)
+        per_layer = [
+            self._block.init(keys[i], sample)["params"]
+            for i in range(self.num_layers)
+        ]
+        blocks = stack_stage_params(per_layer)  # leading dim = num_layers
+        return {"params": {"embed_head": eh, "blocks": blocks}}
+
+    def apply(self, variables, tokens, train: bool = False, rngs=None):
+        params = variables["params"]
+        x = self._embed_head.apply({"params": params["embed_head"]}, tokens)
+
+        from tpuframe.core.runtime import current_runtime
+
+        mesh = current_runtime().mesh
+        n_stages = mesh.shape.get(PIPELINE_AXIS, 1)
+        if self.num_layers % max(n_stages, 1):
+            raise ValueError(
+                f"num_layers={self.num_layers} must divide into "
+                f"{n_stages} pipeline stages"
+            )
+        layers_per_stage = self.num_layers // max(n_stages, 1)
+
+        # regroup the layer-stacked params as (S, layers_per_stage, ...)
+        blocks = jax.tree.map(
+            lambda a: a.reshape((n_stages, layers_per_stage) + a.shape[1:]),
+            params["blocks"],
+        )
+
+        def stage_fn(stage_p, y):
+            for i in range(layers_per_stage):
+                layer_p = jax.tree.map(lambda a: a[i], stage_p)
+                y = self._block.apply({"params": layer_p}, y, train=train)
+            return y
+
+        b = x.shape[0]
+        m = min(self.n_microbatches, b)
+        if b % m:
+            raise ValueError(
+                f"batch size {b} must be divisible by n_microbatches={m}"
+            )
+        micro = x.reshape((m, b // m) + x.shape[1:])
+        out = gpipe_spmd(stage_fn, blocks, micro, mesh=mesh)
+        x = out.reshape((b,) + out.shape[2:])
+        return self._embed_head.apply(
+            {"params": params["embed_head"]}, x, method=self._embed_head.head
+        )
