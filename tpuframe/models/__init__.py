@@ -35,3 +35,6 @@ __all__ = [
     "TransferClassifier",
     "backbone_frozen_labels",
 ]
+
+from tpuframe.models.moe import MoEMLP, moe_rules  # noqa: E402
+__all__ += ["MoEMLP", "moe_rules"]

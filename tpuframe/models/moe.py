@@ -1,0 +1,136 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+Absent from the vision-only reference (SURVEY.md §2.2 marks EP "No"), but
+the ``expert`` mesh axis is first-class in tpuframe.  TPU-first design —
+the GShard/Switch dense-dispatch formulation: routing becomes einsums
+against one-hot dispatch/combine tensors (MXU work, static shapes), and
+expert parallelism is *declared* by sharding the expert-stacked weights
+``(E, ...)`` over the ``expert`` axis — GSPMD inserts the all-to-alls
+that imperative MoE frameworks hand-write.
+
+Components:
+- :class:`MoEMLP` — drop-in replacement for a transformer block's MLP:
+  top-k softmax gating, capacity-factor truncation, load-balancing aux
+  loss (Switch-style) exposed via the ``"aux_loss"`` mutable collection.
+- :func:`moe_rules` — ParallelPlan rules placing expert weights on the
+  ``expert`` axis (compose with the TP/fsdp rules).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import EXPERT_AXIS
+
+
+def moe_rules():
+    """ParallelPlan rules: expert-stacked weights shard over ``expert``."""
+    return (
+        (r"(^|/)(w_in|w_out)$", P(EXPERT_AXIS, None, None)),
+    )
+
+
+class MoEMLP(nn.Module):
+    """Top-k gated mixture of expert MLPs (dense dispatch).
+
+    Args:
+      num_experts: E.
+      mlp_ratio: hidden = d_model * mlp_ratio per expert.
+      top_k: experts per token (1 = Switch, 2 = GShard default).
+      capacity_factor: per-expert slots = ceil(top_k * N / E * factor);
+        overflow tokens are dropped (their combine weight is zero), the
+        standard Switch behavior.
+      aux_loss_weight: weight of the load-balancing loss, stored in the
+        ``aux_loss`` mutable collection for the train step to pick up.
+    """
+
+    num_experts: int = 8
+    mlp_ratio: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        *lead, d = x.shape
+        n = 1
+        for s in lead:
+            n *= s
+        tokens = x.reshape(n, d)
+        e = self.num_experts
+        k = min(self.top_k, e)
+        capacity = max(1, int(-(-(k * n) // e) * self.capacity_factor))
+
+        # --- routing ----------------------------------------------------
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="router"
+        )(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+
+        # top-k expert choices per token
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (N, k)
+        # renormalize chosen gates to sum 1 (GShard convention)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9
+        )
+
+        # position of each (token, choice) inside its expert's buffer:
+        # count prior assignments to the same expert in flattened
+        # (choice-major) order, so choice 0 fills before choice 1
+        choice_exp = gate_idx.T.reshape(-1)  # (k*N,) choice-major
+        onehot = jax.nn.one_hot(choice_exp, e, dtype=jnp.int32)  # (kN, E)
+        pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot - onehot
+        pos = jnp.sum(pos_in_expert, axis=-1)  # (kN,)
+        keep = pos < capacity
+
+        # dispatch/combine in the flattened (kN,) frame
+        tok_idx = jnp.tile(jnp.arange(n), k)  # token of each flat slot
+        disp = (
+            jax.nn.one_hot(choice_exp, e, dtype=x.dtype)[:, :, None]
+            * jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                             dtype=x.dtype)[:, None, :]
+            * keep[:, None, None]
+        )  # (kN, E, C)
+        gates_flat = gate_vals.T.reshape(-1)  # choice-major to match
+
+        # expert inputs: (E, C, D)
+        expert_in = jnp.einsum(
+            "fec,fd->ecd", disp, tokens[tok_idx].astype(self.dtype)
+        )
+
+        # --- expert computation (E stacked MLPs, shardable over expert) --
+        h = d * self.mlp_ratio
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (e, d, h), self.dtype
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (e, h, d), self.dtype
+        )
+        expert_out = jnp.einsum(
+            "ecd,edh->ech", expert_in, w_in
+        )
+        expert_out = nn.gelu(expert_out)
+        expert_out = jnp.einsum("ech,ehd->ecd", expert_out, w_out)
+
+        # --- combine -----------------------------------------------------
+        combine = disp * gates_flat[:, None, None]  # (kN, E, C)
+        out_flat = jnp.einsum("fec,ecd->fd", combine, expert_out)
+        # sum the k choices back per token
+        out = jnp.zeros((n, d), out_flat.dtype).at[tok_idx].add(out_flat)
+
+        # --- load-balance aux loss (Switch eq. 4) ------------------------
+        # fraction of tokens routed to each expert (by top-1 choice) x
+        # mean router prob; scaled by E so balanced = 1.0
+        top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+        aux = jnp.sum(
+            jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0)
+        ) * e * self.aux_loss_weight
+        self.sow("aux_loss", "moe", aux)
+
+        return out.reshape(*lead, d).astype(x.dtype)

@@ -54,10 +54,26 @@ def cross_entropy(
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
+@functools.lru_cache(maxsize=64)
+def _supports_mutable(apply_fn) -> bool:
+    """True when ``apply_fn`` takes flax's ``mutable=`` kwarg."""
+    import inspect
+
+    try:
+        return "mutable" in inspect.signature(apply_fn).parameters
+    except (TypeError, ValueError):  # builtins / C callables
+        return False
+
+
 def _forward(state: TrainState, params: Any, batch: Mapping[str, jax.Array],
              policy: Policy, train: bool, rng: jax.Array | None,
              loss_fn: LossFn):
-    """Shared forward: handles batch_stats mutability and dropout rngs."""
+    """Shared forward: handles batch_stats mutability, dropout rngs, and
+    auxiliary losses (``aux_loss`` collection — MoE load balancing).
+
+    Returns (losses, logits, new_stats, aux) where ``aux`` is the summed
+    auxiliary loss (0.0 when the model sows none); train steps add it to
+    the objective so e.g. MoE routers actually feel their balance loss."""
     variables = {"params": policy.cast_params_for_compute(params)}
     has_stats = bool(jax.tree.leaves(state.batch_stats))
     if has_stats:
@@ -69,17 +85,26 @@ def _forward(state: TrainState, params: Any, batch: Mapping[str, jax.Array],
     # alias the reference examples use.  Int inputs pass cast_batch untouched.
     x = batch["input"] if "input" in batch else batch["image"]
     x = policy.cast_batch(x)
-    if train and has_stats:
-        logits, updates = state.apply_fn(
-            variables, x, mutable=["batch_stats"], **kwargs
-        )
-        new_stats = updates["batch_stats"]
+    aux = jnp.zeros((), jnp.float32)
+    if train:
+        if _supports_mutable(state.apply_fn):
+            mutable = ["aux_loss"] + (["batch_stats"] if has_stats else [])
+            logits, updates = state.apply_fn(variables, x, mutable=mutable, **kwargs)
+        else:
+            # non-flax apply_fn (e.g. PipelinedTransformerLM's duck-typed
+            # adapter) takes no `mutable` kwarg
+            logits = state.apply_fn(variables, x, **kwargs)
+            updates = {}
+        new_stats = updates.get("batch_stats", state.batch_stats)
+        aux_leaves = jax.tree.leaves(updates.get("aux_loss", {}))
+        if aux_leaves:
+            aux = sum(jnp.sum(a) for a in aux_leaves)
     else:
         logits = state.apply_fn(variables, x, **kwargs)
         new_stats = state.batch_stats
     logits = policy.cast_outputs(logits)
     losses = loss_fn(logits, batch["label"])
-    return losses, logits, new_stats
+    return losses, logits, new_stats, aux
 
 
 def _bind_loss(loss_fn: LossFn, plan: ParallelPlan | None) -> LossFn:
@@ -138,12 +163,15 @@ def make_train_step(
         rng = state.step_rng("dropout")
 
         def compute_loss(params):
-            losses, logits, new_stats = _forward(
+            losses, logits, new_stats, aux = _forward(
                 state, params, batch, policy, True, rng, loss_fn
             )
-            return jnp.mean(losses), (logits, new_stats)
+            data_loss = jnp.mean(losses)
+            # aux (MoE load balance etc.) joins the objective; metrics
+            # report the data loss so learning curves stay comparable
+            return data_loss + aux, (data_loss, logits, new_stats)
 
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+        (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
@@ -175,7 +203,7 @@ def make_eval_step(
     loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
-        losses, logits, _ = _forward(
+        losses, logits, _, _ = _forward(
             state, state.params, batch, policy, False, None, loss_fn
         )
         labels = batch["label"]
@@ -243,13 +271,14 @@ def make_grad_accum_step(
             mb_rng = jax.random.fold_in(rng, micro_idx)
 
             def compute_loss(params):
-                losses, logits, new_stats = _forward(
+                losses, logits, new_stats, aux = _forward(
                     state.replace(batch_stats=stats),
                     params, mb, policy, True, mb_rng, loss_fn,
                 )
-                return jnp.mean(losses), (logits, new_stats)
+                data_loss = jnp.mean(losses)
+                return data_loss + aux, (data_loss, logits, new_stats)
 
-            (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True
             )(state.params)
             labels = mb["label"]
