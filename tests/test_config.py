@@ -102,3 +102,42 @@ def test_env_overlay_conflict_raises(monkeypatch):
     monkeypatch.setenv("TPUFRAME_TRAIN__LR", "0.1")
     with pytest.raises(ValueError):
         Config().overlay_env()
+
+
+class TestWorkspace:
+    def test_idempotent_layout(self, tmp_path):
+        from tpuframe.core import Workspace
+
+        ws = Workspace(str(tmp_path / "ws"))
+        ws2 = Workspace(str(tmp_path / "ws"))  # second bootstrap: no error
+        assert ws.checkpoints == ws2.checkpoints
+        assert (tmp_path / "ws" / ".tpuframe-workspace").exists()
+        d = ws.dataset_dir("cifar10")
+        assert d.endswith("datasets/cifar10") and ws.dataset_dir("cifar10") == d
+        assert ws.shards_dir("tiny").endswith("shards/tiny")
+        assert ws.run_dir("exp1").endswith("runs/exp1")
+        for p in (ws.checkpoints, ws.mlruns, d):
+            import os as _os
+
+            assert _os.path.isdir(p)
+
+    def test_local_scratch_per_rank(self, tmp_path, monkeypatch):
+        from tpuframe.core import Workspace
+
+        monkeypatch.setenv("TPUFRAME_LOCAL_SCRATCH", str(tmp_path / "scratch"))
+        monkeypatch.setenv("TPUFRAME_PROCESS_ID", "3")
+        ws = Workspace(str(tmp_path / "ws"))
+        s = ws.local_scratch("stream")
+        assert "host3" in s and s.endswith("stream")
+
+    def test_export_worker_env(self, monkeypatch):
+        import os as _os
+
+        from tpuframe.core import export_worker_env
+
+        monkeypatch.delenv("MLFLOW_TRACKING_TOKEN", raising=False)
+        export_worker_env({"MLFLOW_TRACKING_TOKEN": "tok"})
+        assert _os.environ["MLFLOW_TRACKING_TOKEN"] == "tok"
+        export_worker_env({"MLFLOW_TRACKING_TOKEN": "other"}, overwrite=False)
+        assert _os.environ["MLFLOW_TRACKING_TOKEN"] == "tok"
+        monkeypatch.delenv("MLFLOW_TRACKING_TOKEN", raising=False)

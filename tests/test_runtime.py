@@ -79,3 +79,38 @@ def test_initialize_half_specified_multihost_raises(monkeypatch):
     with pytest.raises(ValueError):
         initialize()
     rt_mod.reset_runtime()
+
+
+def test_debug_mode_enables_nan_checks():
+    """TPUFRAME r02: debug=True is the CUDA_LAUNCH_BLOCKING/NaN-check
+    equivalent (`setup/00_setup.py:66-67`): the first NaN raises at the
+    producing op instead of poisoning downstream metrics."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from tpuframe.core import runtime as rt
+
+    rt.reset_runtime()
+    try:
+        rt.initialize(debug=True)
+        assert jax.config.jax_debug_nans
+        with pytest.raises(FloatingPointError):
+            jnp.log(jnp.zeros(4)) * 0.0 + jnp.divide(0.0, 0.0)
+    finally:
+        rt.reset_runtime()
+    assert not jax.config.jax_debug_nans
+
+
+def test_debug_mode_env_knob(monkeypatch):
+    import jax
+
+    from tpuframe.core import runtime as rt
+
+    monkeypatch.setenv("TPUFRAME_DEBUG", "1")
+    rt.reset_runtime()
+    try:
+        rt.initialize()
+        assert jax.config.jax_debug_nans
+    finally:
+        rt.reset_runtime()

@@ -1,6 +1,7 @@
 """Core runtime: configuration, process/runtime init, device meshes, control plane."""
 
 from tpuframe.core.config import AUTO, Config, load_config
+from tpuframe.core.workspace import Workspace, export_worker_env
 from tpuframe.core.runtime import (
     DATA_AXIS,
     EXPERT_AXIS,
@@ -18,6 +19,8 @@ from tpuframe.core.runtime import (
 )
 
 __all__ = [
+    "Workspace",
+    "export_worker_env",
     "AUTO",
     "Config",
     "load_config",

@@ -164,6 +164,7 @@ def initialize(
     num_processes: int | None = None,
     process_id: int | None = None,
     platform: str | None = None,
+    debug: bool | None = None,
 ) -> Runtime:
     """Initialize the distributed runtime and build the global mesh.
 
@@ -172,8 +173,25 @@ def initialize(
     reference's torchrun contract); values fall back to env vars
     ``TPUFRAME_COORDINATOR`` (or ``MASTER_ADDR``+``MASTER_PORT``),
     ``WORLD_SIZE``/``TPUFRAME_NUM_PROCESSES``, ``RANK``/``TPUFRAME_PROCESS_ID``.
+
+    ``debug=True`` (or env ``TPUFRAME_DEBUG=1``) is the XLA counterpart of
+    the reference's CUDA debug env block (`setup/00_setup.py:66-67,117-123`
+    — ``CUDA_LAUNCH_BLOCKING``/``TORCH_DISTRIBUTED_DEBUG``): enables
+    ``jax_debug_nans`` (first NaN raises at the op that produced it,
+    de-optimizing like launch-blocking does) and ``jax_disable_most_optimizations``
+    for deterministic, debuggable compiles.  Leave off for performance runs.
     """
     global _CURRENT
+
+    if debug is None:
+        debug = os.environ.get("TPUFRAME_DEBUG", "").strip().lower() not in (
+            "", "0", "false", "no", "off",
+        )
+    if debug:
+        global _DEBUG_FLAGS_SET
+        jax.config.update("jax_debug_nans", True)
+        jax.config.update("jax_disable_most_optimizations", True)
+        _DEBUG_FLAGS_SET = True
 
     coordinator_address = coordinator_address or _env_coordinator()
     if num_processes is None:
@@ -230,10 +248,20 @@ def current_runtime(auto_init: bool = True) -> Runtime:
     return _CURRENT
 
 
+_DEBUG_FLAGS_SET = False
+
+
 def reset_runtime() -> None:
-    """Drop the cached Runtime (tests / re-init with a different mesh)."""
-    global _CURRENT
+    """Drop the cached Runtime (tests / re-init with a different mesh).
+
+    Clears the debug-mode jax flags only when ``initialize(debug=True)``
+    set them — flags the user enabled directly are left alone."""
+    global _CURRENT, _DEBUG_FLAGS_SET
     _CURRENT = None
+    if _DEBUG_FLAGS_SET:
+        jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_disable_most_optimizations", False)
+        _DEBUG_FLAGS_SET = False
 
 
 def process_index() -> int:

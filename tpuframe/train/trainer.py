@@ -414,6 +414,21 @@ class Trainer:
         """Global, mask-correct eval over the eval dataloader."""
         if self.eval_dataloader is None:
             raise ValueError("no eval_dataloader")
+        if getattr(self.eval_dataloader, "drop_last", False) and not getattr(
+            self, "_warned_eval_drop", False
+        ):
+            # eval counts silently lose the ragged tail with drop_last=True;
+            # the mask contract (DataLoader(drop_last=False) third element)
+            # exists precisely so eval never miscounts
+            import warnings
+
+            warnings.warn(
+                "eval_dataloader has drop_last=True: the final ragged batch "
+                "is skipped and eval metrics undercount; use "
+                "drop_last=False (yields a validity mask) for exact eval",
+                stacklevel=2,
+            )
+            self._warned_eval_drop = True
         state = self.init_state()
         self.eval_dataloader.set_epoch(0)
         acc = None
