@@ -377,3 +377,94 @@ class TestTrainerSharded:
             is_leaf=lambda s: True,
         )
         assert any("fsdp" in tuple(jax.tree.leaves(list(s), is_leaf=lambda e: True)) or "fsdp" in str(s) for s in specs)
+
+
+class TestDeviceNormalize:
+    def test_uint8_on_device_normalize_matches_host_prenormalized(self):
+        # normalize=(mean,std): uint8 crosses to the device raw and is
+        # normalized inside the jitted step — must train identically to
+        # feeding host-prenormalized floats.
+        from flax import linen as nn
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+        mean, std = (0.4, 0.45, 0.5), (0.2, 0.25, 0.3)
+        rng = np.random.default_rng(11)
+        raw = rng.integers(0, 256, (32, 8, 8, 3), dtype=np.uint8)
+        labels = rng.integers(0, 4, (32,)).astype(np.int32)
+        floats = (raw.astype(np.float32) / 255.0 - np.asarray(mean)) / np.asarray(std)
+
+        class Arrays:
+            def __init__(self, images):
+                self.images = images
+
+            def __len__(self):
+                return len(self.images)
+
+            def __getitem__(self, i):
+                return self.images[i], int(labels[i])
+
+        finals = []
+        trainers = []
+        for images, norm in ((raw, (mean, std)), (floats.astype(np.float32), None)):
+            loader = DataLoader(
+                Arrays(images), 16, shuffle=False, process_index=0, process_count=1
+            )
+            trainer = Trainer(
+                Lin(),
+                train_dataloader=loader,
+                max_duration="1ep",
+                optimizer="sgd",
+                lr=1e-2,
+                num_classes=4,
+                log_interval=0,
+                normalize=norm,
+                sample_input=floats[:1].astype(np.float32),
+            )
+            result = trainer.fit()
+            trainers.append(trainer)
+            finals.append((result.metrics["train_loss"], trainer.state.params))
+        assert finals[0][0] == pytest.approx(finals[1][0], rel=1e-4)
+        for a, b in zip(jax.tree.leaves(finals[0][1]), jax.tree.leaves(finals[1][1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        # predict() must apply the same normalization: raw uint8 into the
+        # normalize trainer == prenormalized floats into the plain one
+        p_raw = trainers[0].predict(raw[:4])
+        p_float = trainers[1].predict(floats[:4].astype(np.float32))
+        np.testing.assert_allclose(p_raw, p_float, atol=1e-3)
+
+    def test_normalize_with_grad_accum(self):
+        from flax import linen as nn
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+        rng = np.random.default_rng(12)
+        raw = rng.integers(0, 256, (32, 8, 8, 3), dtype=np.uint8)
+        labels = rng.integers(0, 4, (32,)).astype(np.int32)
+
+        class Arrays:
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return raw[i], int(labels[i])
+
+        loader = DataLoader(Arrays(), 16, process_index=0, process_count=1)
+        trainer = Trainer(
+            Lin(),
+            train_dataloader=loader,
+            max_duration="1ep",
+            num_classes=4,
+            log_interval=0,
+            grad_accum=2,
+            normalize=((0.5, 0.5, 0.5), (0.25, 0.25, 0.25)),
+            sample_input=np.zeros((1, 8, 8, 3), np.float32),
+        )
+        result = trainer.fit()
+        assert np.isfinite(result.metrics["train_loss"])

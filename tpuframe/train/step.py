@@ -148,18 +148,23 @@ def make_train_step(
     loss_fn: LossFn = cross_entropy,
     donate: bool = True,
     plan: ParallelPlan | None = None,
+    batch_transform: Callable[[dict], dict] | None = None,
 ) -> Callable[[TrainState, Mapping[str, jax.Array]], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch) -> (state, metrics).
 
     Metrics are summed (loss_sum, correct, count) so they aggregate exactly
     across microbatches and hosts — the mean is taken by whoever logs.
     ``plan`` (optional) lets the default cross-entropy run its Pallas
-    kernel per batch shard over the plan's mesh.
+    kernel per batch shard over the plan's mesh.  ``batch_transform``
+    runs *inside* the jitted program (e.g. fused uint8 normalization:
+    ship raw bytes over PCIe, normalize on-chip).
     """
     policy = policy or full_precision()
     loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        if batch_transform is not None:
+            batch = batch_transform(dict(batch))
         rng = state.step_rng("dropout")
 
         def compute_loss(params):
@@ -192,6 +197,7 @@ def make_eval_step(
     policy: Policy | None = None,
     loss_fn: LossFn = cross_entropy,
     plan: ParallelPlan | None = None,
+    batch_transform: Callable[[dict], dict] | None = None,
 ) -> Callable[[TrainState, Mapping[str, jax.Array]], dict]:
     """Jitted eval step: (state, batch) -> summed metrics.
 
@@ -203,6 +209,8 @@ def make_eval_step(
     loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        if batch_transform is not None:
+            batch = batch_transform(dict(batch))
         losses, logits, _, _ = _forward(
             state, state.params, batch, policy, False, None, loss_fn
         )
@@ -227,12 +235,19 @@ def make_eval_step(
 
 def make_predict_fn(
     policy: Policy | None = None,
+    input_transform: Callable[[jax.Array], jax.Array] | None = None,
 ) -> Callable[[TrainState, jax.Array], jax.Array]:
     """Jitted logits fn for inference (the reference's ``predict_image``
-    path, `02_cifar_torch_distributor_resnet.py:370-387`)."""
+    path, `02_cifar_torch_distributor_resnet.py:370-387`).
+
+    ``input_transform`` runs inside the jitted program — the Trainer wires
+    its ``normalize=`` transform here so inference sees the same
+    preprocessing as training."""
     policy = policy or full_precision()
 
     def predict(state: TrainState, x: jax.Array) -> jax.Array:
+        if input_transform is not None:
+            x = input_transform(x)
         variables = {"params": policy.cast_params_for_compute(state.params)}
         if jax.tree.leaves(state.batch_stats):
             variables["batch_stats"] = state.batch_stats
@@ -248,6 +263,7 @@ def make_grad_accum_step(
     loss_fn: LossFn = cross_entropy,
     donate: bool = True,
     plan: ParallelPlan | None = None,
+    batch_transform: Callable[[dict], dict] | None = None,
 ):
     """Gradient accumulation over leading-dim microbatches via ``lax.scan``.
 
@@ -265,6 +281,11 @@ def make_grad_accum_step(
 
         def micro(carry, scanned):
             mb, micro_idx = scanned
+            # transform per microbatch: a whole-super-batch transform
+            # before the scan would materialize the full float copy and
+            # defeat grad-accum's memory purpose
+            if batch_transform is not None:
+                mb = batch_transform(dict(mb))
             grads_acc, stats, metrics = carry
             # distinct dropout mask per microbatch — matching what the same
             # samples would draw as separate steps

@@ -113,6 +113,7 @@ class Trainer:
         log_interval: int = 10,
         report: Callable[[dict, str | None], None] | None = None,
         grad_accum: int = 1,
+        normalize: tuple | None = None,
     ):
         self.model = model
         self.train_dataloader = train_dataloader
@@ -160,17 +161,66 @@ class Trainer:
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum
+        # ``normalize=(mean, std[, scale])``: images cross host->HBM raw
+        # (uint8 = 4x less PCIe traffic than f32) and are normalized
+        # *inside* the jitted step by the fused Pallas kernel — the
+        # reference's host-side ToTensor+Normalize
+        # (`utils/hf_dataset_utilities.py:70-80`) with the same
+        # convention: inputs in 0-255 (uint8 or float — algorithms like
+        # MixUp emit 0-255 floats), mean/std in [0, 1] units.  Pass an
+        # explicit third element to override the 1/255 scale.
+        self.normalize = normalize
+
+        def image_transform(img, mesh):
+            from tpuframe.ops import normalize_images
+
+            mean, std, *rest = normalize
+            return normalize_images(
+                img, mean, std, scale=rest[0] if rest else 1.0 / 255.0,
+                out_dtype=self.policy.compute_dtype, mesh=mesh,
+                batch_axes=tuple(self.plan.data_axes),
+            )
+
+        train_transform = eval_transform = None
+        if normalize is not None:
+            # the mesh-sharded kernel matches the plain (B, ...) layout;
+            # grad-accum train batches are (n_micro, micro, ...) and are
+            # normalized per microbatch inside the scan (mesh=None there —
+            # XLA shards + fuses the jnp path natively).  Eval batches are
+            # never microbatched, so eval always keeps the kernel path.
+            def train_transform(batch: dict) -> dict:
+                mesh = self.plan.mesh if self.grad_accum == 1 else None
+                batch["image"] = image_transform(batch["image"], mesh)
+                return batch
+
+            def eval_transform(batch: dict) -> dict:
+                batch["image"] = image_transform(batch["image"], self.plan.mesh)
+                return batch
+
         if grad_accum > 1:
             # DeepSpeed's gradient_accumulation_steps
             # (`deepspeed_config.py:17`): host batches are reshaped to
             # (n_micro, micro, ...) in _device_batches.
             self._train_step = make_grad_accum_step(
-                grad_accum, self.policy, loss_fn, plan=self.plan
+                grad_accum, self.policy, loss_fn, plan=self.plan,
+                batch_transform=train_transform,
             )
         else:
-            self._train_step = make_train_step(self.policy, loss_fn, plan=self.plan)
-        self._eval_step = make_eval_step(self.policy, loss_fn, plan=self.plan)
-        self._predict = make_predict_fn(self.policy)
+            self._train_step = make_train_step(
+                self.policy, loss_fn, plan=self.plan,
+                batch_transform=train_transform,
+            )
+        self._eval_step = make_eval_step(
+            self.policy, loss_fn, plan=self.plan, batch_transform=eval_transform
+        )
+        self._predict = make_predict_fn(
+            self.policy,
+            input_transform=(
+                (lambda x: image_transform(x, self.plan.mesh))
+                if normalize is not None
+                else None
+            ),
+        )
 
     # -- wiring ------------------------------------------------------------
     @property
