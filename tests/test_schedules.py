@@ -1,0 +1,194 @@
+"""LR schedule parity: DeepSpeed WarmupLR / torch CosineAnnealingLR /
+StepLR semantics, dict-config resolution, and Trainer wiring."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.train.schedules import (
+    cosine_annealing,
+    from_config,
+    resolve_schedule,
+    step_decay,
+    warmup_cosine,
+    warmup_decay_lr,
+    warmup_lr,
+)
+
+
+def _f(x):
+    return float(np.asarray(x))
+
+
+class TestWarmupLR:
+    def test_linear_ramp_then_hold(self):
+        s = warmup_lr(2e-4, 100, min_lr=0.0)
+        assert _f(s(0)) == pytest.approx(0.0)
+        assert _f(s(50)) == pytest.approx(1e-4)
+        assert _f(s(100)) == pytest.approx(2e-4)
+        assert _f(s(10_000)) == pytest.approx(2e-4)  # holds forever
+
+    def test_min_lr_floor(self):
+        s = warmup_lr(1e-3, 10, min_lr=1e-5)
+        assert _f(s(0)) == pytest.approx(1e-5)
+        assert _f(s(5)) == pytest.approx(1e-5 + (1e-3 - 1e-5) / 2)
+
+    def test_log_warmup_monotone_and_endpoints(self):
+        s = warmup_lr(1.0, 100, warmup_type="log")
+        vals = [_f(s(i)) for i in range(0, 101, 10)]
+        assert vals[0] == pytest.approx(0.0)
+        assert vals[-1] == pytest.approx(1.0, abs=1e-6)
+        assert all(b >= a for a, b in zip(vals, vals[1:]))
+        # log ramp is ahead of linear mid-warmup
+        assert _f(s(10)) > 10 / 100
+
+    def test_zero_warmup_is_constant(self):
+        s = warmup_lr(3e-4, 0)
+        assert _f(s(0)) == pytest.approx(3e-4)
+        assert _f(s(999)) == pytest.approx(3e-4)
+
+    def test_traceable_under_jit(self):
+        import jax
+
+        s = warmup_lr(1e-3, 10)
+        out = jax.jit(lambda step: s(step))(jnp.asarray(5))
+        assert _f(out) == pytest.approx(5e-4)
+
+
+class TestWarmupDecayLR:
+    def test_ramp_peak_decay_zero(self):
+        s = warmup_decay_lr(1e-3, 10, 110)
+        assert _f(s(0)) == pytest.approx(0.0)
+        assert _f(s(10)) == pytest.approx(1e-3)
+        assert _f(s(60)) == pytest.approx(5e-4)
+        assert _f(s(110)) == pytest.approx(0.0, abs=1e-9)
+        assert _f(s(200)) == pytest.approx(0.0, abs=1e-9)
+
+    def test_rejects_total_before_warmup(self):
+        with pytest.raises(ValueError, match="total_steps"):
+            warmup_decay_lr(1e-3, 100, 50)
+
+
+class TestCosineAnnealing:
+    def test_matches_torch_formula(self):
+        # torch: eta_min + (base - eta_min) * (1 + cos(pi * t / T_max)) / 2
+        base, t_max, eta_min = 0.1, 50, 1e-3
+        s = cosine_annealing(base, t_max, eta_min=eta_min)
+        for t in [0, 7, 25, 49, 50]:
+            expect = eta_min + (base - eta_min) * (1 + np.cos(np.pi * t / t_max)) / 2
+            assert _f(s(t)) == pytest.approx(expect, rel=1e-6), t
+
+    def test_holds_eta_min_past_t_max(self):
+        s = cosine_annealing(0.1, 10, eta_min=0.01)
+        assert _f(s(10)) == pytest.approx(0.01)
+        assert _f(s(100)) == pytest.approx(0.01)
+
+
+class TestStepDecay:
+    def test_staircase(self):
+        s = step_decay(1.0, 30, gamma=0.1)
+        assert _f(s(0)) == pytest.approx(1.0)
+        assert _f(s(29)) == pytest.approx(1.0)
+        assert _f(s(30)) == pytest.approx(0.1)
+        assert _f(s(60)) == pytest.approx(0.01, rel=1e-5)
+
+
+class TestWarmupCosine:
+    def test_shape(self):
+        s = warmup_cosine(1e-2, 10, 100, end_lr=1e-4)
+        assert _f(s(10)) == pytest.approx(1e-2, rel=1e-5)
+        assert _f(s(100)) == pytest.approx(1e-4, rel=1e-3)
+        assert _f(s(5)) < 1e-2
+
+
+class TestFromConfig:
+    # the reference's exact scheduler block (`deepspeed_config.py:33-40`)
+    DS = {
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {
+                "warmup_min_lr": 0,
+                "warmup_max_lr": 2e-4,
+                "warmup_num_steps": 100,
+                "warmup_type": "linear",
+            },
+        }
+    }
+
+    def test_deepspeed_full_config(self):
+        s = from_config(self.DS)
+        assert _f(s(50)) == pytest.approx(1e-4)
+        assert _f(s(500)) == pytest.approx(2e-4)
+
+    def test_scheduler_block_directly(self):
+        s = from_config(self.DS["scheduler"])
+        assert _f(s(100)) == pytest.approx(2e-4)
+
+    def test_warmup_decay_auto_total(self):
+        cfg = {
+            "type": "WarmupDecayLR",
+            "params": {"warmup_max_lr": 1e-3, "warmup_num_steps": 10,
+                       "total_num_steps": "auto"},
+        }
+        s = from_config(cfg, total_steps=110)
+        assert _f(s(110)) == pytest.approx(0.0, abs=1e-9)
+
+    def test_auto_without_total_raises(self):
+        cfg = {"type": "WarmupDecayLR",
+               "params": {"warmup_max_lr": 1e-3, "warmup_num_steps": 10}}
+        with pytest.raises(ValueError, match="auto"):
+            from_config(cfg)
+
+    def test_cosine_and_step_types(self):
+        s = from_config({"type": "CosineAnnealingLR",
+                         "params": {"base_lr": 0.1, "T_max": 10}})
+        assert _f(s(10)) == pytest.approx(0.0, abs=1e-8)
+        s = from_config({"type": "StepLR",
+                         "params": {"base_lr": 1.0, "step_size": 5, "gamma": 0.5}})
+        assert _f(s(5)) == pytest.approx(0.5)
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ValueError, match="unknown scheduler"):
+            from_config({"type": "OneCycle", "params": {}})
+
+    def test_missing_type_wrapper_raises(self):
+        # forgetting the {"type": ..., "params": {...}} wrapper must not
+        # silently become a constant-0 schedule
+        with pytest.raises(ValueError, match="no 'type' key"):
+            from_config({"warmup_max_lr": 1e-3, "warmup_num_steps": 500})
+
+    def test_resolve_schedule_passthrough(self):
+        assert resolve_schedule(1e-3) == pytest.approx(1e-3)
+        fn = warmup_lr(1.0, 5)
+        assert resolve_schedule(fn) is fn
+        s = resolve_schedule(self.DS)
+        assert _f(s(100)) == pytest.approx(2e-4)
+
+
+class TestTrainerWiring:
+    def test_trainer_accepts_scheduler_dict(self):
+        """lr= takes the DeepSpeed scheduler dict; total 'auto' resolves
+        from max_duration x loader length."""
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.models import ResNet18
+        from tpuframe.train import Trainer
+
+        ds = SyntheticImageDataset(n=32, image_size=8, num_classes=4, seed=0)
+        loader = DataLoader(ds, batch_size=8, shuffle=True, seed=0)
+        tr = Trainer(
+            ResNet18(num_classes=4, stem="cifar"),
+            train_dataloader=loader,
+            max_duration="2ep",
+            optimizer="adamw",
+            lr={
+                "type": "WarmupDecayLR",
+                "params": {"warmup_max_lr": 1e-3, "warmup_num_steps": 2,
+                           "total_num_steps": "auto"},
+            },
+            eval_interval=0,
+            log_interval=0,
+        )
+        result = tr.fit()
+        assert result.error is None
+        # 2 epochs x 4 batches trained at a decaying lr
+        assert tr.batches_seen == 8

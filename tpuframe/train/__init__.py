@@ -16,6 +16,14 @@ from tpuframe.train.algorithms import (
 )
 from tpuframe.train.callbacks import Callback, EarlyStopping, ProgressLogger
 from tpuframe.train.duration import Duration
+from tpuframe.train.schedules import (
+    cosine_annealing,
+    step_decay,
+    warmup_cosine,
+    warmup_decay_lr,
+    warmup_lr,
+)
+from tpuframe.train.schedules import from_config as schedule_from_config
 from tpuframe.train.state import TrainState, create_train_state, param_count
 from tpuframe.train.step import (
     cross_entropy,
@@ -40,6 +48,12 @@ __all__ = [
     "EarlyStopping",
     "ProgressLogger",
     "Duration",
+    "warmup_lr",
+    "warmup_decay_lr",
+    "warmup_cosine",
+    "cosine_annealing",
+    "step_decay",
+    "schedule_from_config",
     "TrainState",
     "create_train_state",
     "param_count",

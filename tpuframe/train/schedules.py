@@ -1,0 +1,245 @@
+"""LR schedules: the reference examples' schedulers, optax-native.
+
+Parity targets:
+
+- DeepSpeed ``WarmupLR`` — linear (or log) ramp ``warmup_min_lr`` →
+  ``warmup_max_lr`` over ``warmup_num_steps``, then hold
+  (`/root/reference/02_deepspeed/deepspeed_config.py:33-40`).
+- DeepSpeed ``WarmupDecayLR`` — same warmup, then linear decay to zero
+  at ``total_num_steps`` (the other scheduler the DeepSpeed docs pair
+  with the base config).
+- torch ``CosineAnnealingLR`` — the Accelerate example's scheduler
+  (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-16`).
+- torch ``StepLR``-style staircase decay.
+- ``warmup_cosine`` — warmup + cosine decay, the idiomatic TPU default.
+
+Every schedule is an ``optax.Schedule`` (``step -> lr``) built from
+``jnp`` ops, so it traces under ``jit`` and lives inside the compiled
+train step — no host-side scheduler object to ``.step()`` (the torch
+pattern) and nothing to checkpoint beyond ``state.step``.
+
+``from_config`` accepts the DeepSpeed-shaped
+``{"type": ..., "params": {...}}`` dict so configs written for the
+reference's ``deepspeed_config.py`` carry their scheduler through
+unchanged; ``"auto"``-style deferred values resolve against the
+caller-supplied ``total_steps``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import optax
+
+__all__ = [
+    "warmup_lr",
+    "warmup_decay_lr",
+    "cosine_annealing",
+    "step_decay",
+    "warmup_cosine",
+    "from_config",
+    "resolve_schedule",
+]
+
+
+def warmup_lr(
+    max_lr: float,
+    warmup_steps: int,
+    *,
+    min_lr: float = 0.0,
+    warmup_type: str = "linear",
+) -> optax.Schedule:
+    """DeepSpeed ``WarmupLR``: ramp to ``max_lr`` then hold forever.
+
+    ``warmup_type="log"`` uses DeepSpeed's logarithmic ramp
+    (``log1p(step)/log1p(warmup_steps)``).
+    """
+    if warmup_steps < 0:
+        raise ValueError(f"warmup_steps must be >= 0, got {warmup_steps}")
+    if warmup_type not in ("linear", "log"):
+        raise ValueError(f"warmup_type must be 'linear' or 'log', got {warmup_type!r}")
+    if warmup_steps == 0:
+        return lambda step: jnp.asarray(max_lr, jnp.float32)
+
+    log_denom = math.log1p(warmup_steps)
+
+    def schedule(step):
+        s = jnp.asarray(step, jnp.float32)
+        if warmup_type == "log":
+            frac = jnp.log1p(s) / log_denom
+        else:
+            frac = s / warmup_steps
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return min_lr + (max_lr - min_lr) * frac
+
+    return schedule
+
+
+def warmup_decay_lr(
+    max_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    *,
+    min_lr: float = 0.0,
+) -> optax.Schedule:
+    """DeepSpeed ``WarmupDecayLR``: linear warmup, then linear decay to 0
+    at ``total_steps``."""
+    if total_steps <= warmup_steps:
+        raise ValueError(
+            f"total_steps ({total_steps}) must exceed warmup_steps ({warmup_steps})"
+        )
+    ramp = warmup_lr(max_lr, warmup_steps, min_lr=min_lr)
+
+    def schedule(step):
+        s = jnp.asarray(step, jnp.float32)
+        decay = (total_steps - s) / (total_steps - warmup_steps)
+        decay = jnp.clip(decay, 0.0, 1.0)
+        return jnp.where(s < warmup_steps, ramp(step), max_lr * decay)
+
+    return schedule
+
+
+def cosine_annealing(
+    base_lr: float, t_max: int, *, eta_min: float = 0.0
+) -> optax.Schedule:
+    """torch ``CosineAnnealingLR``: half-cosine from ``base_lr`` to
+    ``eta_min`` over ``t_max`` steps, holding ``eta_min`` after (torch
+    would oscillate back up; training past ``T_max`` is out-of-contract
+    there, so hold is the safer tail)."""
+    if t_max <= 0:
+        raise ValueError(f"t_max must be > 0, got {t_max}")
+
+    def schedule(step):
+        t = jnp.clip(jnp.asarray(step, jnp.float32), 0.0, t_max)
+        return eta_min + 0.5 * (base_lr - eta_min) * (1.0 + jnp.cos(jnp.pi * t / t_max))
+
+    return schedule
+
+
+def step_decay(
+    base_lr: float, step_size: int, *, gamma: float = 0.1
+) -> optax.Schedule:
+    """torch ``StepLR``: multiply by ``gamma`` every ``step_size`` steps."""
+    return optax.exponential_decay(
+        base_lr, transition_steps=step_size, decay_rate=gamma, staircase=True
+    )
+
+
+def warmup_cosine(
+    max_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    *,
+    end_lr: float = 0.0,
+    init_lr: float = 0.0,
+) -> optax.Schedule:
+    """Linear warmup into cosine decay — the TPU-idiomatic default."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=init_lr,
+        peak_value=max_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=end_lr,
+    )
+
+
+def _resolve_auto(value: Any, name: str, fallback: int | None) -> int:
+    """DeepSpeed-style ``"auto"`` resolution against a caller-known total."""
+    if value in ("auto", None):
+        if fallback is None:
+            raise ValueError(
+                f"scheduler param {name!r} is 'auto' but no total_steps was "
+                "supplied to resolve it (pass total_steps=, or set the param "
+                "explicitly)"
+            )
+        return int(fallback)
+    return int(value)
+
+
+def from_config(
+    cfg: Mapping[str, Any], *, total_steps: int | None = None
+) -> optax.Schedule:
+    """Build a schedule from a DeepSpeed-shaped scheduler dict.
+
+    Accepts either the full config (reads its ``"scheduler"`` key) or the
+    scheduler block itself: ``{"type": "WarmupLR", "params": {...}}``
+    (`deepspeed_config.py:33-40`).  ``total_num_steps: "auto"`` (and a
+    missing ``total_num_steps`` on decaying types) resolves to
+    ``total_steps``.
+    """
+    sched = cfg.get("scheduler", cfg)
+    kind = str(sched.get("type", "")).strip()
+    params = dict(sched.get("params", {}))
+    k = kind.lower()
+
+    if k in ("warmuplr", "warmup"):
+        return warmup_lr(
+            max_lr=float(params["warmup_max_lr"]),
+            warmup_steps=int(params.get("warmup_num_steps", 0)),
+            min_lr=float(params.get("warmup_min_lr", 0.0)),
+            warmup_type=params.get("warmup_type", "linear"),
+        )
+    if k == "warmupdecaylr":
+        return warmup_decay_lr(
+            max_lr=float(params["warmup_max_lr"]),
+            warmup_steps=int(params.get("warmup_num_steps", 0)),
+            total_steps=_resolve_auto(
+                params.get("total_num_steps", "auto"), "total_num_steps", total_steps
+            ),
+            min_lr=float(params.get("warmup_min_lr", 0.0)),
+        )
+    if k in ("warmupcosinelr", "warmup_cosine"):
+        total = _resolve_auto(
+            params.get("total_num_steps", "auto"), "total_num_steps", total_steps
+        )
+        return warmup_cosine(
+            max_lr=float(params.get("warmup_max_lr", params.get("max_lr", 0.0))),
+            warmup_steps=int(params.get("warmup_num_steps", 0)),
+            total_steps=total,
+            end_lr=float(params.get("cos_min_ratio", 0.0))
+            * float(params.get("warmup_max_lr", params.get("max_lr", 0.0))),
+        )
+    if k in ("cosineannealinglr", "cosine", "cosine_annealing"):
+        return cosine_annealing(
+            base_lr=float(params["base_lr"]),
+            t_max=_resolve_auto(params.get("T_max", "auto"), "T_max", total_steps),
+            eta_min=float(params.get("eta_min", 0.0)),
+        )
+    if k in ("steplr", "step", "step_decay"):
+        return step_decay(
+            base_lr=float(params["base_lr"]),
+            step_size=int(params["step_size"]),
+            gamma=float(params.get("gamma", 0.1)),
+        )
+    if k in ("constant", "constantlr"):
+        lr = float(params.get("lr", params.get("base_lr", 0.0)))
+        return lambda step: jnp.asarray(lr, jnp.float32)
+    if not kind:
+        # a dict with no "type" is almost always a forgotten
+        # {"type": ..., "params": {...}} wrapper — silently training at a
+        # constant 0.0 lr would be the worst possible outcome.
+        raise ValueError(
+            "scheduler dict has no 'type' key; expected the DeepSpeed shape "
+            '{"type": "WarmupLR", "params": {...}} (or a config with a '
+            '"scheduler" key)'
+        )
+    raise ValueError(
+        f"unknown scheduler type {kind!r}; known: WarmupLR, WarmupDecayLR, "
+        "WarmupCosineLR, CosineAnnealingLR, StepLR, constant"
+    )
+
+
+def resolve_schedule(
+    spec: float | Mapping[str, Any] | optax.Schedule,
+    *,
+    total_steps: int | None = None,
+):
+    """Trainer-facing resolver: float → constant, dict → :func:`from_config`,
+    callable → as-is."""
+    if isinstance(spec, Mapping):
+        return from_config(spec, total_steps=total_steps)
+    if callable(spec):
+        return spec
+    return float(spec)

@@ -73,7 +73,9 @@ class Trainer:
 
     Args:
       model: flax module with ``__call__(x, train: bool)``.
-      tx: optax transform (or use ``optimizer=`` name + ``lr=``).
+      tx: optax transform (or use ``optimizer=`` name + ``lr=``; ``lr``
+        also takes an optax schedule or a DeepSpeed-shaped scheduler dict,
+        see ``tpuframe.train.schedules``).
       train_dataloader / eval_dataloader: tpuframe DataLoaders.
       max_duration: ``"2ep"`` / ``"500ba"`` / int epochs.
       algorithms: batch algorithms (LabelSmoothing, CutMix, ...).
@@ -96,7 +98,7 @@ class Trainer:
         eval_dataloader: DataLoader | None = None,
         *,
         optimizer: str = "adam",
-        lr: float | optax.Schedule = 1e-3,
+        lr: float | Mapping[str, Any] | optax.Schedule = 1e-3,
         max_duration: str | int = "1ep",
         algorithms: Sequence[Algorithm] = (),
         callbacks: Sequence[Callback] = (),
@@ -135,7 +137,7 @@ class Trainer:
         self.plan = plan
 
         if tx is None:
-            tx = _make_optimizer(optimizer, lr)
+            tx = _make_optimizer(optimizer, self._resolve_lr(lr))
         self.tx = tx
 
         if num_classes is None:
@@ -223,6 +225,19 @@ class Trainer:
         )
 
     # -- wiring ------------------------------------------------------------
+    def _resolve_lr(self, lr):
+        """Accept a float, an optax schedule, or a DeepSpeed-shaped
+        scheduler dict (``{"type": "WarmupLR", "params": {...}}`` or a full
+        config carrying a ``"scheduler"`` key — `deepspeed_config.py:33-40`);
+        ``total_num_steps: "auto"`` resolves against max_duration and the
+        train dataloader."""
+        from tpuframe.train.schedules import resolve_schedule
+
+        return resolve_schedule(
+            lr,
+            total_steps=_planned_total_steps(self.max_duration, self.train_dataloader),
+        )
+
     @property
     def is_main(self) -> bool:
         return rt.is_main_process()
@@ -492,6 +507,25 @@ class Trainer:
         single-image demo path adds the batch dim itself)."""
         state = self.init_state()
         return np.asarray(self._predict(state, np.asarray(images)))
+
+
+def _planned_total_steps(duration, dataloader) -> int | None:
+    """Best-effort optimizer-step count for schedule resolution (the
+    DeepSpeed ``total_num_steps: "auto"`` pattern,
+    `deepspeed_config.py:16` style deferred values)."""
+    if duration.unit == "ba":
+        return duration.value
+    if dataloader is None:
+        return None
+    if duration.unit == "ep":
+        try:
+            return duration.value * len(dataloader)
+        except TypeError:
+            return None
+    # "sp": samples -> batches at the loader's global batch size.  The loop
+    # stops when samples_seen >= value, i.e. after ceil(value/gbs) steps.
+    gbs = getattr(dataloader, "global_batch_size", None)
+    return max(-(-duration.value // gbs), 1) if gbs else None
 
 
 def _make_optimizer(name: str, lr: float | optax.Schedule) -> optax.GradientTransformation:
