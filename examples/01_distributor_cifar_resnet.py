@@ -31,7 +31,7 @@ from tpuframe import core
 from tpuframe.data import DataLoader, SyntheticImageDataset, Timer
 from tpuframe.launch import Distributor
 from tpuframe.models import ResNet18
-from tpuframe.parallel import ParallelPlan, bf16_compute, full_precision
+from tpuframe.parallel import ParallelPlan, align_model_dtype, bf16_compute, full_precision
 from tpuframe.track import MLflowLogger
 from tpuframe.train import (
     create_train_state,
@@ -55,8 +55,12 @@ def train_cifar(cfg: dict):
     )
     loader = DataLoader(train_ds, cfg["batch_size"], shuffle=True, seed=cfg["seed"])
 
-    model = ResNet18(num_classes=cfg["num_classes"], stem="cifar")
     policy = bf16_compute() if rt.platform == "tpu" else full_precision()
+    # align the model's compute dtype with the policy (f32 model under a
+    # bf16 policy would silently up-cast inside every layer)
+    model = align_model_dtype(
+        ResNet18(num_classes=cfg["num_classes"], stem="cifar"), policy
+    )
     state = create_train_state(
         model, jax.random.PRNGKey(cfg["seed"]),
         jnp.ones((1, cfg["image_size"], cfg["image_size"], 3)),

@@ -52,7 +52,7 @@ from tpuframe.data import (
 )
 from tpuframe.launch import Distributor
 from tpuframe.models import ResNet50
-from tpuframe.parallel import ParallelPlan, bf16_compute, full_precision
+from tpuframe.parallel import ParallelPlan, align_model_dtype, bf16_compute, full_precision
 from tpuframe.track import MLflowLogger
 from tpuframe.train import (
     create_train_state,
@@ -151,8 +151,8 @@ def train_tiny_imagenet(cfg: dict):
     )
     val_loader = DataLoader(val_ds, cfg["batch_size"], drop_last=False)
 
-    model = ResNet50(num_classes=cfg["num_classes"])
     policy = bf16_compute() if rt.platform == "tpu" else full_precision()
+    model = align_model_dtype(ResNet50(num_classes=cfg["num_classes"]), policy)
     state = create_train_state(
         model, jax.random.PRNGKey(cfg["seed"]),
         jnp.ones((1, cfg["image_size"], cfg["image_size"], 3)),

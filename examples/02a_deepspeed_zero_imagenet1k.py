@@ -40,7 +40,7 @@ from tpuframe import core
 from tpuframe.data import DataLoader
 from tpuframe.launch import ZeroDistributor
 from tpuframe.models import ResNet50
-from tpuframe.parallel import ZeroConfig, bf16_compute, full_precision
+from tpuframe.parallel import ZeroConfig, align_model_dtype, bf16_compute, full_precision
 from tpuframe.train import (
     create_train_state,
     make_eval_step,
@@ -72,8 +72,8 @@ def train_imagenet1k(cfg: dict, zero_config: ZeroConfig | None = None):
     )
     val_loader = DataLoader(val_ds, cfg["batch_size"], drop_last=False)
 
-    model = ResNet50(num_classes=cfg["num_classes"])
     policy = bf16_compute() if rt.platform == "tpu" else full_precision()
+    model = align_model_dtype(ResNet50(num_classes=cfg["num_classes"]), policy)
     # AdamW + linear warmup, the base-config optimizer (`deepspeed_config.py:28-40`)
     schedule = optax.linear_schedule(0.0, cfg["lr"], cfg["warmup_steps"])
     state = create_train_state(

@@ -169,6 +169,43 @@ class TestPrecision:
         with pytest.raises(ValueError):
             get_policy("fp8_nope")
 
+    def test_align_model_dtype(self):
+        """An f32 model under a bf16 policy must be cloned to bf16 compute —
+        otherwise every layer up-casts and the HBM-bound step pays double
+        traffic (the 1.4k->2.3k img/s v5e finding)."""
+        from tpuframe.models import ResNet18
+        from tpuframe.parallel import align_model_dtype, full_precision
+
+        m = ResNet18(num_classes=10, stem="cifar")
+        assert m.dtype == jnp.float32
+        aligned = align_model_dtype(m, bf16_compute())
+        assert aligned.dtype == jnp.bfloat16
+        assert aligned.num_classes == 10  # clone keeps other fields
+        # no-op when already aligned / for dtype-less objects
+        assert align_model_dtype(aligned, bf16_compute()) is aligned
+        assert align_model_dtype(m, full_precision()) is m
+        sentinel = object()
+        assert align_model_dtype(sentinel, bf16_compute()) is sentinel
+
+    def test_trainer_aligns_model_to_policy(self):
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.models import ResNet18
+        from tpuframe.train import Trainer
+
+        ds = SyntheticImageDataset(n=16, image_size=8, num_classes=4, seed=0)
+        tr = Trainer(
+            ResNet18(num_classes=4, stem="cifar"),
+            train_dataloader=DataLoader(ds, batch_size=8),
+            precision="bf16",
+            eval_interval=0,
+            log_interval=0,
+        )
+        assert tr.model.dtype == jnp.bfloat16
+        # params stay f32 master copies (init under param_dtype)
+        state = tr.init_state()
+        leaf = jax.tree.leaves(state.params)[0]
+        assert leaf.dtype == jnp.float32
+
 
 class TestHostOffload:
     def _shapes(self):

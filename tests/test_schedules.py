@@ -68,6 +68,12 @@ class TestWarmupDecayLR:
         with pytest.raises(ValueError, match="total_steps"):
             warmup_decay_lr(1e-3, 100, 50)
 
+    def test_decays_to_min_lr_floor(self):
+        # DeepSpeed decays back to warmup_min_lr, not to zero
+        s = warmup_decay_lr(1e-3, 10, 110, min_lr=1e-5)
+        assert _f(s(110)) == pytest.approx(1e-5)
+        assert _f(s(1000)) == pytest.approx(1e-5)
+
 
 class TestCosineAnnealing:
     def test_matches_torch_formula(self):
@@ -150,6 +156,12 @@ class TestFromConfig:
     def test_unknown_type_raises(self):
         with pytest.raises(ValueError, match="unknown scheduler"):
             from_config({"type": "OneCycle", "params": {}})
+
+    def test_warmup_cosine_requires_peak(self):
+        with pytest.raises(ValueError, match="warmup_max_lr"):
+            from_config({"type": "WarmupCosineLR",
+                         "params": {"warmup_num_steps": 100,
+                                    "total_num_steps": 1000}})
 
     def test_missing_type_wrapper_raises(self):
         # forgetting the {"type": ..., "params": {...}} wrapper must not

@@ -8,6 +8,7 @@ collectives the reference performed imperatively through NCCL.
 
 from tpuframe.parallel.precision import (
     Policy,
+    align_model_dtype,
     bf16_compute,
     full_precision,
     get_policy,
@@ -42,6 +43,7 @@ __all__ = [
     "pipeline_param_spec",
     "stack_stage_params",
     "Policy",
+    "align_model_dtype",
     "bf16_compute",
     "full_precision",
     "get_policy",

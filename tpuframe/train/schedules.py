@@ -96,7 +96,10 @@ def warmup_decay_lr(
         s = jnp.asarray(step, jnp.float32)
         decay = (total_steps - s) / (total_steps - warmup_steps)
         decay = jnp.clip(decay, 0.0, 1.0)
-        return jnp.where(s < warmup_steps, ramp(step), max_lr * decay)
+        # DeepSpeed decays back to the min_lr floor, not to zero
+        return jnp.where(
+            s < warmup_steps, ramp(step), min_lr + (max_lr - min_lr) * decay
+        )
 
     return schedule
 
@@ -194,12 +197,17 @@ def from_config(
         total = _resolve_auto(
             params.get("total_num_steps", "auto"), "total_num_steps", total_steps
         )
+        peak = params.get("warmup_max_lr", params.get("max_lr"))
+        if peak is None:
+            raise ValueError(
+                "WarmupCosineLR needs 'warmup_max_lr' (or 'max_lr') — a "
+                "missing peak would silently train at lr 0"
+            )
         return warmup_cosine(
-            max_lr=float(params.get("warmup_max_lr", params.get("max_lr", 0.0))),
+            max_lr=float(peak),
             warmup_steps=int(params.get("warmup_num_steps", 0)),
             total_steps=total,
-            end_lr=float(params.get("cos_min_ratio", 0.0))
-            * float(params.get("warmup_max_lr", params.get("max_lr", 0.0))),
+            end_lr=float(params.get("cos_min_ratio", 0.0)) * float(peak),
         )
     if k in ("cosineannealinglr", "cosine", "cosine_annealing"):
         return cosine_annealing(

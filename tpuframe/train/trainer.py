@@ -32,7 +32,7 @@ import optax
 
 from tpuframe.core import runtime as rt
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
-from tpuframe.parallel.precision import Policy, get_policy
+from tpuframe.parallel.precision import Policy, align_model_dtype, get_policy
 from tpuframe.parallel.sharding import ParallelPlan
 from tpuframe.train.algorithms import Algorithm, apply_algorithms, resolve_algorithms
 from tpuframe.train.callbacks import Callback
@@ -117,13 +117,13 @@ class Trainer:
         grad_accum: int = 1,
         normalize: tuple | None = None,
     ):
-        self.model = model
+        self.policy = get_policy(precision)
+        self.model = align_model_dtype(model, self.policy)
         self.train_dataloader = train_dataloader
         self.eval_dataloader = eval_dataloader
         self.max_duration = Duration.parse(max_duration)
         self.callbacks = list(callbacks)
         self.loggers = list(loggers)
-        self.policy = get_policy(precision)
         self.loss_fn = loss_fn
         self.seed = seed
         self.checkpointer = checkpointer
