@@ -1,0 +1,124 @@
+"""BatchNorm DP-statistics option: sync (global) vs local (per-replica).
+
+SURVEY.md §7 "Hard parts" requires the choice to be explicit; torch DDP's
+default is per-replica stats (plain DDP wrap, no SyncBatchNorm —
+`01_basic_torch_distributor.py:289-291`), while SPMD BatchNorm under jit
+is global by construction."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.models import ResNet18
+from tpuframe.models.norm import ReplicaGroupedBatchNorm
+
+
+def _bn_oracle(x, eps=1e-5):
+    """Plain batch norm over the full array (no affine: scale=1, bias=0)."""
+    axes = tuple(range(x.ndim - 1))
+    mean = x.mean(axes)
+    var = x.var(axes)
+    return (x - mean) / np.sqrt(var + eps)
+
+
+class TestReplicaGroupedBatchNorm:
+    def _apply(self, x, groups, train=True, stats=None):
+        m = ReplicaGroupedBatchNorm(use_running_average=not train, groups=groups)
+        variables = m.init(jax.random.PRNGKey(0), x)
+        if stats is not None:
+            variables = {**variables, "batch_stats": stats}
+        if train:
+            y, updates = m.apply(variables, x, mutable=["batch_stats"])
+            return np.asarray(y), jax.tree.map(np.asarray, updates["batch_stats"])
+        return np.asarray(m.apply(variables, x)), None
+
+    def test_single_group_matches_global_bn(self):
+        x = np.random.default_rng(0).standard_normal((8, 4, 4, 3)).astype(np.float32)
+        y, _ = self._apply(x, groups=1)
+        np.testing.assert_allclose(y, _bn_oracle(x), atol=1e-5)
+
+    def test_groups_match_per_shard_oracle(self):
+        """groups=G output == per-sub-batch BN applied independently —
+        exactly what G torch-DDP replicas would each compute locally."""
+        x = np.random.default_rng(1).standard_normal((12, 2, 2, 5)).astype(np.float32)
+        y, _ = self._apply(x, groups=3)
+        expect = np.concatenate([_bn_oracle(s) for s in np.split(x, 3)], axis=0)
+        np.testing.assert_allclose(y, expect, atol=1e-5)
+
+    def test_local_differs_from_sync_on_skewed_batch(self):
+        rng = np.random.default_rng(2)
+        x = np.concatenate(
+            [rng.standard_normal((4, 2, 2, 3)), 5 + rng.standard_normal((4, 2, 2, 3))]
+        ).astype(np.float32)
+        y_sync, _ = self._apply(x, groups=1)
+        y_local, _ = self._apply(x, groups=2)
+        assert np.abs(y_sync - y_local).max() > 0.1
+
+    def test_running_stats_are_group_mean(self):
+        x = np.random.default_rng(3).standard_normal((8, 2, 2, 3)).astype(np.float32)
+        _, stats = self._apply(x, groups=2)
+        mean_g = x.reshape(2, 4, 2, 2, 3).mean((1, 2, 3))
+        expect_mean = 0.1 * mean_g.mean(0)  # momentum 0.9, init 0
+        np.testing.assert_allclose(stats["mean"], expect_mean, atol=1e-6)
+
+    def test_eval_uses_running_buffers(self):
+        x = np.random.default_rng(4).standard_normal((6, 2, 2, 3)).astype(np.float32)
+        stats = {"mean": jnp.full((3,), 2.0), "var": jnp.full((3,), 4.0)}
+        y, _ = self._apply(x, groups=3, train=False, stats=stats)
+        np.testing.assert_allclose(y, (x - 2.0) / np.sqrt(4.0 + 1e-5), atol=1e-5)
+
+    def test_variable_layout_matches_flax_bn(self):
+        """params scale/bias + batch_stats mean/var — the interop contract."""
+        m = ReplicaGroupedBatchNorm(groups=2)
+        v = m.init(jax.random.PRNGKey(0), jnp.ones((4, 2, 2, 3)))
+        assert set(v["params"]) == {"scale", "bias"}
+        assert set(v["batch_stats"]) == {"mean", "var"}
+        ref = nn.BatchNorm(use_running_average=False).init(
+            jax.random.PRNGKey(0), jnp.ones((4, 2, 2, 3))
+        )
+        assert set(ref["params"]) == set(v["params"])
+        assert set(ref["batch_stats"]) == set(v["batch_stats"])
+
+    def test_indivisible_batch_raises(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            self._apply(np.ones((7, 2, 2, 3), np.float32), groups=2)
+
+
+class TestResNetBnStats:
+    def test_local_resnet_runs_and_differs_from_sync(self):
+        x = np.random.default_rng(0).standard_normal((8, 16, 16, 3)).astype(np.float32)
+        out = {}
+        for label, kw in [
+            ("sync", {}),
+            ("local", {"bn_stats": "local", "bn_groups": 4}),
+        ]:
+            m = ResNet18(num_classes=4, stem="cifar", **kw)
+            v = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+            y, _ = m.apply(v, x, train=True, mutable=["batch_stats"])
+            out[label] = np.asarray(y)
+        assert np.isfinite(out["local"]).all()
+        assert np.abs(out["sync"] - out["local"]).max() > 1e-5
+
+    def test_unknown_bn_stats_raises(self):
+        m = ResNet18(num_classes=4, stem="cifar", bn_stats="nope")
+        with pytest.raises(ValueError, match="bn_stats"):
+            m.init({"params": jax.random.PRNGKey(0)}, jnp.ones((2, 16, 16, 3)))
+
+    def test_trainer_autofills_groups_from_plan(self):
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.train import Trainer
+
+        ds = SyntheticImageDataset(n=64, image_size=8, num_classes=4, seed=0)
+        tr = Trainer(
+            ResNet18(num_classes=4, stem="cifar", bn_stats="local"),
+            train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=0),
+            max_duration="1ep",
+            eval_interval=0,
+            log_interval=0,
+        )
+        assert tr.model.bn_groups == tr.plan.dp_size > 1
+        result = tr.fit()
+        assert result.error is None
+        assert np.isfinite(result.metrics["train_loss"])

@@ -206,6 +206,24 @@ class TestPrecision:
         leaf = jax.tree.leaves(state.params)[0]
         assert leaf.dtype == jnp.float32
 
+    def test_trainer_follows_explicit_model_dtype(self):
+        """No precision= given: an explicitly-bf16 model must NOT be
+        downcast to the f32 default — the policy follows the model."""
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.models import ResNet18
+        from tpuframe.train import Trainer
+
+        ds = SyntheticImageDataset(n=16, image_size=8, num_classes=4, seed=0)
+        tr = Trainer(
+            ResNet18(num_classes=4, stem="cifar", dtype=jnp.bfloat16),
+            train_dataloader=DataLoader(ds, batch_size=8),
+            eval_interval=0,
+            log_interval=0,
+        )
+        assert tr.model.dtype == jnp.bfloat16
+        assert tr.policy.compute_dtype == jnp.bfloat16
+        assert tr.policy.param_dtype == jnp.float32
+
 
 class TestHostOffload:
     def _shapes(self):

@@ -19,6 +19,7 @@ from tpuframe.models.resnet import (
     ResNet50,
     ResNet101,
 )
+from tpuframe.models.norm import ReplicaGroupedBatchNorm
 from tpuframe.models.transfer import TransferClassifier, backbone_frozen_labels
 
 __all__ = [
@@ -32,6 +33,7 @@ __all__ = [
     "ResNet34",
     "ResNet50",
     "ResNet101",
+    "ReplicaGroupedBatchNorm",
     "TransferClassifier",
     "backbone_frozen_labels",
 ]

@@ -1,0 +1,105 @@
+"""Batch normalization with an explicit DP-statistics choice.
+
+SURVEY.md §7 "Hard parts": *BatchNorm under DP — per-replica BN stats vs
+cross-replica sync-BN changes convergence vs the torch reference; must be
+an explicit option.*
+
+Under ``jit`` + GSPMD sharding, a plain reduction over the batch axis IS
+a global reduction — flax's ``nn.BatchNorm`` on a data-sharded batch is
+cross-replica sync-BN by construction (XLA inserts the cross-chip
+all-reduce of the moments).  torch DDP's default is the opposite: each
+replica normalizes with its own local-batch statistics
+(`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:289-291`
+wraps in DDP without SyncBatchNorm, so BN stays per-replica).
+
+:class:`ReplicaGroupedBatchNorm` reproduces the per-replica semantics in
+SPMD form: the global batch is reshaped to ``(groups, B/groups, ...)``
+and moments are taken per group.  When ``groups`` equals the number of
+data shards and the batch axis is sharded over them, the reshape aligns
+group boundaries with shard boundaries, so the moment reductions stay
+shard-local and no cross-chip collective is emitted — per-replica BN is
+simultaneously the torch-DDP-parity choice *and* the cheaper one on an
+ICI mesh.
+
+Running statistics: each group contributes its batch moments, and the
+running buffers are updated with the group-mean — torch DDP would let
+each replica's buffers drift independently and checkpoint rank 0's; a
+single global array cannot drift per replica, so averaging the groups is
+the SPMD-faithful equivalent.  Eval always normalizes with the shared
+running buffers (identical everywhere, like the reference's rank-0
+checkpoint reloaded on every worker).
+
+Variable layout matches ``nn.BatchNorm`` (params ``scale``/``bias``,
+batch_stats ``mean``/``var``) so checkpoints and the torch interop table
+(`tpuframe/models/interop.py`) work unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ReplicaGroupedBatchNorm(nn.Module):
+    """BatchNorm computing train-time moments per batch group.
+
+    ``groups=1`` is exactly global (sync) BN.  ``groups=N`` with the batch
+    sharded N ways over the data axes gives torch-DDP per-replica
+    semantics with shard-local reductions.
+
+    Args:
+      use_running_average: eval mode — normalize with running buffers.
+      groups: number of statistic groups; global batch must divide evenly.
+      momentum / epsilon: as ``nn.BatchNorm``.
+      dtype: output dtype (moments and affine are always float32).
+    """
+
+    use_running_average: bool = False
+    groups: int = 1
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        feat = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (feat,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((feat,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((feat,), jnp.float32)
+        )
+
+        if self.use_running_average:
+            y = (x.astype(jnp.float32) - ra_mean.value) * jax.lax.rsqrt(
+                ra_var.value + self.epsilon
+            )
+            return (y * scale + bias).astype(self.dtype)
+
+        g = self.groups
+        b = x.shape[0]
+        if g < 1 or b % g:
+            raise ValueError(
+                f"batch size {b} must divide evenly into {g} BN groups"
+            )
+        xg = x.reshape((g, b // g) + x.shape[1:]).astype(jnp.float32)
+        axes = tuple(range(1, xg.ndim - 1))  # sub-batch + spatial dims
+        mean_g = jnp.mean(xg, axes)  # (g, C)
+        # E[x^2] - E[x]^2 ("fast variance"): one pass over the activations
+        # instead of two — this is the HBM-bound part of the op.
+        var_g = jnp.maximum(jnp.mean(xg * xg, axes) - mean_g**2, 0.0)
+
+        bshape = (g,) + (1,) * len(axes) + (feat,)
+        y = (xg - mean_g.reshape(bshape)) * jax.lax.rsqrt(
+            var_g.reshape(bshape) + self.epsilon
+        )
+        y = (y * scale + bias).reshape(x.shape).astype(self.dtype)
+
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * jnp.mean(mean_g, 0)
+            ra_var.value = m * ra_var.value + (1 - m) * jnp.mean(var_g, 0)
+        return y

@@ -109,6 +109,17 @@ class ResNet(nn.Module):
             `setup/resnet18.py:35-39` keeps the maxpool even for CIFAR).
       dtype: activation/compute dtype (bf16 recommended on TPU); params and
              BN statistics are kept float32.
+      bn_stats: "sync" (default) computes train-time BN moments over the
+            global batch — the SPMD-natural choice (XLA all-reduces the
+            moments over the data axes).  "local" reproduces torch DDP's
+            per-replica BN (`01_basic_torch_distributor.py:289-291` uses
+            plain DDP, not SyncBatchNorm) via ``bn_groups`` statistic
+            groups; with groups == data shards the reductions stay
+            shard-local (no cross-chip collective).  SURVEY.md §7 flags
+            this convergence-relevant choice as necessarily explicit.
+      bn_groups: statistic groups for ``bn_stats="local"`` (0 = treat as
+            sync; the Trainer auto-fills it with the plan's data shard
+            count).
     """
 
     stage_sizes: Sequence[int]
@@ -118,6 +129,8 @@ class ResNet(nn.Module):
     stem: str = "imagenet"
     dtype: jnp.dtype = jnp.float32
     act: Callable = nn.relu
+    bn_stats: str = "sync"
+    bn_groups: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -128,13 +141,31 @@ class ResNet(nn.Module):
             padding="SAME",
             kernel_init=nn.initializers.he_normal(),
         )
-        norm = functools.partial(
-            nn.BatchNorm,
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=jnp.float32,  # statistics + affine in f32 for stability
-        )
+        if self.bn_stats == "local" and self.bn_groups > 1:
+            from tpuframe.models.norm import ReplicaGroupedBatchNorm
+
+            norm = functools.partial(
+                ReplicaGroupedBatchNorm,
+                use_running_average=not train,
+                groups=self.bn_groups,
+                momentum=0.9,
+                epsilon=1e-5,
+                # f32 output like the sync branch: the bn_stats knob must
+                # toggle ONLY the statistics scope, not activation dtype
+                dtype=jnp.float32,
+            )
+        elif self.bn_stats in ("sync", "local"):
+            norm = functools.partial(
+                nn.BatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=jnp.float32,  # statistics + affine in f32 for stability
+            )
+        else:
+            raise ValueError(
+                f"unknown bn_stats {self.bn_stats!r}; expected 'sync' or 'local'"
+            )
 
         x = x.astype(self.dtype)
         if self.stem == "imagenet":

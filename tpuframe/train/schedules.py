@@ -5,9 +5,9 @@ Parity targets:
 - DeepSpeed ``WarmupLR`` — linear (or log) ramp ``warmup_min_lr`` →
   ``warmup_max_lr`` over ``warmup_num_steps``, then hold
   (`/root/reference/02_deepspeed/deepspeed_config.py:33-40`).
-- DeepSpeed ``WarmupDecayLR`` — same warmup, then linear decay to zero
-  at ``total_num_steps`` (the other scheduler the DeepSpeed docs pair
-  with the base config).
+- DeepSpeed ``WarmupDecayLR`` — same warmup, then linear decay back to
+  the ``warmup_min_lr`` floor at ``total_num_steps`` (the other
+  scheduler the DeepSpeed docs pair with the base config).
 - torch ``CosineAnnealingLR`` — the Accelerate example's scheduler
   (`/root/reference/04_accelerate/01_cifar_accelerate.ipynb:cell-16`).
 - torch ``StepLR``-style staircase decay.
@@ -84,8 +84,9 @@ def warmup_decay_lr(
     *,
     min_lr: float = 0.0,
 ) -> optax.Schedule:
-    """DeepSpeed ``WarmupDecayLR``: linear warmup, then linear decay to 0
-    at ``total_steps``."""
+    """DeepSpeed ``WarmupDecayLR``: linear warmup, then linear decay back
+    to the ``min_lr`` floor at ``total_steps`` (DeepSpeed holds the floor,
+    not zero)."""
     if total_steps <= warmup_steps:
         raise ValueError(
             f"total_steps ({total_steps}) must exceed warmup_steps ({warmup_steps})"

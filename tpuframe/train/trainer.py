@@ -84,7 +84,11 @@ class Trainer:
         (tpuframe.track trackers fit; anything duck-typed works).  Rank-0
         discipline is enforced *here*, not by each logger.
       plan: ParallelPlan (default: pure DP over the current runtime mesh).
-      precision: policy name or Policy ("bf16" recommended on TPU).
+      precision: policy name or Policy ("bf16" recommended on TPU).  When
+        given, it is the source of truth: the model is cloned so its
+        compute dtype matches.  When omitted, the policy follows the
+        model's own ``dtype`` knob (explicitly-bf16 models keep bf16
+        compute with f32 master params).
       checkpointer: tpuframe.ckpt.Checkpointer (optional; saved per
         ``checkpoint_interval`` epochs + best tracking).
       eval_interval: run eval every N epochs (0 = never).
@@ -104,7 +108,7 @@ class Trainer:
         callbacks: Sequence[Callback] = (),
         loggers: Sequence[Any] = (),
         plan: ParallelPlan | None = None,
-        precision: str | Policy = "fp32",
+        precision: str | Policy | None = None,
         loss_fn: Callable = cross_entropy,
         seed: int = 0,
         num_classes: int | None = None,
@@ -117,8 +121,17 @@ class Trainer:
         grad_accum: int = 1,
         normalize: tuple | None = None,
     ):
-        self.policy = get_policy(precision)
-        self.model = align_model_dtype(model, self.policy)
+        if precision is None:
+            # follow the model: an explicitly-bf16 model keeps bf16 compute
+            # (f32 masters); an f32 model gets the plain f32 policy
+            self.policy = Policy(compute_dtype=getattr(model, "dtype", jnp.float32))
+            self.model = model
+        else:
+            # an explicit policy is the source of truth: align the model to
+            # it (an f32 model under a bf16 policy would silently up-cast
+            # inside every layer and double the HBM traffic)
+            self.policy = get_policy(precision)
+            self.model = align_model_dtype(model, self.policy)
         self.train_dataloader = train_dataloader
         self.eval_dataloader = eval_dataloader
         self.max_duration = Duration.parse(max_duration)
@@ -135,6 +148,14 @@ class Trainer:
         if plan is None:
             plan = ParallelPlan(mesh=rt.current_runtime().mesh)
         self.plan = plan
+        # per-replica BN ("local") needs to know the data shard count; the
+        # model can't see the mesh, so fill it from the plan here
+        if (
+            getattr(self.model, "bn_stats", None) == "local"
+            and not getattr(self.model, "bn_groups", 1)
+            and hasattr(self.model, "clone")
+        ):
+            self.model = self.model.clone(bn_groups=plan.dp_size)
 
         if tx is None:
             tx = _make_optimizer(optimizer, self._resolve_lr(lr))
