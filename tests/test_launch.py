@@ -217,6 +217,12 @@ def test_tpu_trainer_refit_same_name_fresh_history(tmp_path):
     second = fit(2)
     # second fit must not merge the first run's 3 reports into its history
     assert len(second.metrics_dataframe) == 2
+    # ...but the first run's data is moved aside, not destroyed (Ray
+    # preserves prior runs; deleting them silently was ADVICE r01)
+    run_dir = tmp_path / "same"
+    prev = [p for p in run_dir.iterdir() if p.name.startswith(".prev_")]
+    assert prev, list(run_dir.iterdir())
+    assert any(f.name == "rank_0.jsonl" for f in prev[0].iterdir())
 
 
 def test_distributor_timeout_surfaces_crashed_peer():

@@ -187,12 +187,53 @@ class TPUTrainer:
             # checkpoint bundles must not leak into (or mask a crash of)
             # this fit's Result — the report seq counter restarts at 0, so a
             # surviving checkpoint_000001 would get new files overlaid on old.
-            for entry in os.listdir(result_dir):
-                path = os.path.join(result_dir, entry)
-                if entry.startswith("rank_") and entry.endswith(".jsonl"):
-                    os.remove(path)
-                elif entry.startswith("checkpoint_") and os.path.isdir(path):
-                    shutil.rmtree(path)
+            # Ray would preserve the old run (fresh fit vs Trainer.restore);
+            # here the prior contents are MOVED ASIDE, not deleted, so
+            # pointing a name at an existing valuable run cannot destroy it.
+            stale = [
+                entry
+                for entry in os.listdir(result_dir)
+                if (entry.startswith("rank_") and entry.endswith(".jsonl"))
+                or (
+                    entry.startswith("checkpoint_")
+                    and os.path.isdir(os.path.join(result_dir, entry))
+                )
+            ]
+            if stale:
+                prev_dir = tempfile.mkdtemp(
+                    prefix=f".prev_{time.strftime('%Y%m%d_%H%M%S')}_",
+                    dir=result_dir,
+                )
+                for entry in stale:
+                    shutil.move(
+                        os.path.join(result_dir, entry),
+                        os.path.join(prev_dir, entry),
+                    )
+                # the preserved history records checkpoint paths under the
+                # live result_dir (which this run will overwrite with its
+                # own seq-0 bundles) — repoint them at the moved copies
+                for entry in os.listdir(prev_dir):
+                    if not (entry.startswith("rank_") and entry.endswith(".jsonl")):
+                        continue
+                    jsonl = os.path.join(prev_dir, entry)
+                    rewritten = []
+                    with open(jsonl) as f:
+                        for line in f:
+                            rec = json.loads(line)
+                            ckpt = rec.get("checkpoint")
+                            if ckpt and os.path.dirname(ckpt) == result_dir:
+                                rec["checkpoint"] = os.path.join(
+                                    prev_dir, os.path.basename(ckpt)
+                                )
+                            rewritten.append(json.dumps(rec))
+                    with open(jsonl, "w") as f:
+                        f.write("\n".join(rewritten) + "\n")
+                print(
+                    f"[tpuframe] run name {self.run_config.name!r} already has "
+                    f"{len(stale)} result entries; moved to {prev_dir} "
+                    "(delete it to reclaim space)",
+                    flush=True,
+                )
         else:
             result_dir = tempfile.mkdtemp(
                 prefix=f"run_{time.strftime('%Y%m%d_%H%M%S')}_", dir=storage
