@@ -151,3 +151,55 @@ def test_torch_resnet_import_round_trip(rng):
         {"params": imported["params"], "batch_stats": imported["batch_stats"]}, x
     )
     assert out.shape == (1, 10)
+
+
+class TestViT:
+    def test_vit_s16_shapes_and_param_count(self, rng):
+        from tpuframe.models import ViT_S16
+
+        model = ViT_S16(num_classes=1000)
+        x = jnp.zeros((2, 224, 224, 3))
+        variables = model.init(rng, x)
+        out = model.apply(variables, x)
+        assert out.shape == (2, 1000)
+        # ViT-S/16 is ~22M params (timm vit_small_patch16_224: 22.1M)
+        assert 21e6 < n_params(variables["params"]) < 23.5e6
+
+    def test_cls_pool_variant(self, rng):
+        from tpuframe.models import ViT
+
+        model = ViT(num_classes=10, patch_size=4, hidden_dim=64,
+                    num_layers=2, num_heads=4, pool="cls")
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(rng, x)
+        assert "cls_token" in variables["params"]
+        # 64 patches + 1 class token
+        assert variables["params"]["pos_embed"].shape == (1, 65, 64)
+        assert model.apply(variables, x).shape == (2, 10)
+
+    def test_bad_patch_divisibility_raises(self, rng):
+        from tpuframe.models import ViT
+
+        model = ViT(num_classes=10, patch_size=16)
+        with pytest.raises(ValueError, match="not divisible"):
+            model.init(rng, jnp.zeros((1, 100, 100, 3)))
+
+    def test_vit_trains_under_trainer(self):
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.models import ViT
+        from tpuframe.train import Trainer
+
+        ds = SyntheticImageDataset(n=64, image_size=16, num_classes=4, seed=0)
+        tr = Trainer(
+            ViT(num_classes=4, patch_size=4, hidden_dim=32, num_layers=2,
+                num_heads=4),
+            train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=0),
+            max_duration="2ep",
+            lr=1e-3,
+            optimizer="adamw",
+            eval_interval=0,
+            log_interval=0,
+        )
+        result = tr.fit()
+        assert result.error is None
+        assert np.isfinite(result.metrics["train_loss"])

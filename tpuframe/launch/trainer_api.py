@@ -216,12 +216,21 @@ class TPUTrainer:
                     if not (entry.startswith("rank_") and entry.endswith(".jsonl")):
                         continue
                     jsonl = os.path.join(prev_dir, entry)
+                    live = os.path.normpath(result_dir)
                     rewritten = []
                     with open(jsonl) as f:
                         for line in f:
-                            rec = json.loads(line)
+                            if not line.strip():
+                                continue
+                            try:
+                                rec = json.loads(line)
+                            except json.JSONDecodeError:
+                                # truncated tail from a killed worker —
+                                # preserve verbatim, like _read_history skips
+                                rewritten.append(line.rstrip("\n"))
+                                continue
                             ckpt = rec.get("checkpoint")
-                            if ckpt and os.path.dirname(ckpt) == result_dir:
+                            if ckpt and os.path.normpath(os.path.dirname(ckpt)) == live:
                                 rec["checkpoint"] = os.path.join(
                                     prev_dir, os.path.basename(ckpt)
                                 )

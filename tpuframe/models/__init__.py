@@ -21,6 +21,7 @@ from tpuframe.models.resnet import (
 )
 from tpuframe.models.norm import ReplicaGroupedBatchNorm
 from tpuframe.models.transfer import TransferClassifier, backbone_frozen_labels
+from tpuframe.models.vit import ViT, ViT_B16, ViT_S16
 
 __all__ = [
     "MnistNet",
@@ -34,6 +35,9 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ReplicaGroupedBatchNorm",
+    "ViT",
+    "ViT_S16",
+    "ViT_B16",
     "TransferClassifier",
     "backbone_frozen_labels",
 ]

@@ -31,6 +31,7 @@ from tpuframe.core.runtime import (
     current_runtime,
 )
 from tpuframe.ops.ring_attention import attention_reference, ring_attention_local
+from tpuframe.ops.ulysses import ulysses_attention_local
 
 
 def transformer_tp_rules():
@@ -59,7 +60,11 @@ class SelfAttention(nn.Module):
     num_heads: int
     head_dim: int
     causal: bool = True
-    attn_impl: str = "auto"  # "auto" | "full" | "ring"
+    #: "auto" picks ring attention when the mesh shards the sequence axis
+    #: (no head-count constraint); "ulysses" opts into the all-to-all form
+    #: (tpuframe.ops.ulysses — one re-shard instead of N-1 ppermute hops,
+    #: needs num_heads divisible by the seq-axis size).
+    attn_impl: str = "auto"  # "auto" | "full" | "ring" | "ulysses"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -84,16 +89,25 @@ class SelfAttention(nn.Module):
         elif impl == "auto":
             seq_sharded = mesh is not None and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
             impl = "ring" if seq_sharded else "full"
-        if impl == "ring":
+        if impl in ("ring", "ulysses"):
             if mesh is None:
-                raise ValueError("attn_impl='ring' needs an initialized runtime mesh")
-            head_axis = MODEL_AXIS if (
-                mesh.shape.get(MODEL_AXIS, 1) > 1
-                and self.num_heads % mesh.shape[MODEL_AXIS] == 0
-            ) else None
+                raise ValueError(
+                    f"attn_impl={impl!r} needs an initialized runtime mesh"
+                )
+            if impl == "ulysses":
+                # the all-to-all owns the head dim during attention, so no
+                # head_axis sharding here (TP composes via the projections)
+                local_fn = ulysses_attention_local
+                head_axis = None
+            else:
+                local_fn = ring_attention_local
+                head_axis = MODEL_AXIS if (
+                    mesh.shape.get(MODEL_AXIS, 1) > 1
+                    and self.num_heads % mesh.shape[MODEL_AXIS] == 0
+                ) else None
             spec = P((DATA_AXIS, FSDP_AXIS), SEQUENCE_AXIS, head_axis, None)
             out = jax.shard_map(
-                lambda q, k, v: ring_attention_local(q, k, v, causal=self.causal),
+                lambda q, k, v: local_fn(q, k, v, causal=self.causal),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,

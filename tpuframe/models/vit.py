@@ -1,0 +1,121 @@
+"""Vision Transformer: the attention-based vision family.
+
+The reference's vision zoo is ResNet-only (`/root/reference/setup/
+resnet18.py`, torchvision ResNet50 wrappers — SURVEY.md §2.1 C6/C8); ViT
+extends tpuframe's coverage to the other standard image backbone while
+reusing the transformer machinery (``tpuframe.models.transformer.Block``
+with ``causal=False``), so every sequence-parallel/TP capability the LM
+family has — ring or Ulysses attention over the ``seq`` axis, Megatron
+rules on the projections — applies to patch sequences unchanged.
+
+TPU-first choices: patch embedding is a single strided conv (one MXU op,
+no gather); learned position embeddings; mean-pool head by default
+(``pool="mean"``) with the classic class-token variant available; all
+compute respects the ``dtype`` knob like the other models.
+
+Standard sizes: ViT-S/16 ≈ 22M params, ViT-B/16 ≈ 86M params.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpuframe.models.transformer import Block
+
+
+class ViT(nn.Module):
+    """(B, H, W, C) images -> (B, num_classes) logits.
+
+    Args:
+      num_classes: classifier width; 0 = no head (feature extractor).
+      patch_size: square patch edge; image H/W must divide evenly.
+      hidden_dim / num_layers / num_heads: encoder shape
+        (head_dim = hidden_dim // num_heads).
+      pool: "mean" (default) or "cls" (prepends a class token; note the
+        token makes the sequence length patches+1, which usually breaks
+        the even seq-shard constraint for SP — mean-pool on a mesh).
+      attn_impl: "auto" | "full" | "ring" | "ulysses" (bidirectional).
+      dtype: activation/compute dtype (bf16 recommended on TPU).
+    """
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    pool: str = "mean"
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        if self.hidden_dim % self.num_heads:
+            raise ValueError(
+                f"hidden_dim {self.hidden_dim} must divide into "
+                f"{self.num_heads} heads"
+            )
+        if self.pool not in ("mean", "cls"):
+            raise ValueError(f"unknown pool {self.pool!r}; 'mean' or 'cls'")
+        p = self.patch_size
+        b, h, w, _ = x.shape
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch size {p}")
+
+        x = x.astype(self.dtype)
+        # patchify = one strided conv straight onto the MXU
+        x = nn.Conv(
+            self.hidden_dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.hidden_dim)  # (B, n_patches, D)
+        n_tokens = x.shape[1]
+
+        if self.pool == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, self.hidden_dim),
+                jnp.float32,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, self.hidden_dim)).astype(self.dtype), x],
+                axis=1,
+            )
+            n_tokens += 1
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, n_tokens, self.hidden_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads,
+                self.hidden_dim // self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout,
+                causal=False,  # bidirectional over patches
+                attn_impl=self.attn_impl,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+
+        x = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
+        if self.num_classes:
+            x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+#: Standard recipes (patch 16): S ≈ 22M, B ≈ 86M params.
+ViT_S16 = functools.partial(ViT, hidden_dim=384, num_layers=12, num_heads=6)
+ViT_B16 = functools.partial(ViT, hidden_dim=768, num_layers=12, num_heads=12)

@@ -26,6 +26,7 @@ from tpuframe.ops.cross_entropy import (
     cross_entropy_reference,
 )
 from tpuframe.ops.fused_adamw import fused_adamw, fused_adamw_update
+from tpuframe.ops.ulysses import ulysses_attention, ulysses_attention_local
 from tpuframe.ops.ring_attention import (
     attention_reference,
     ring_attention,
@@ -36,6 +37,8 @@ __all__ = [
     "attention_reference",
     "ring_attention",
     "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
     "use_pallas",
     "normalize_images",
     "normalize_images_reference",
