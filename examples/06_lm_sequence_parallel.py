@@ -1,0 +1,139 @@
+"""Long-context LM training with sequence parallelism (ring or Ulysses).
+
+The reference repo is vision-only — its scaling axis is image resolution
+(SURVEY.md §5 long-context row: "absent") — but tpuframe treats
+long-context as first-class.  This recipe trains a decoder-only
+TransformerLM on synthetic token streams with the sequence dimension
+sharded over the mesh's ``seq`` axis:
+
+- ``--attn ring``     K/V rotate the ICI ring via ppermute (exact, O((L/N)^2)
+                      score memory — the extreme-length choice);
+- ``--attn ulysses``  all-to-all head<->sequence re-sharding (DeepSpeed-
+                      Ulysses pattern; needs heads % seq_shards == 0);
+- ``--attn full``     no SP, the single-chip baseline.
+
+Composable with the rest of the ladder: ZeRO via ``--zero-stage`` shards
+optimizer state over the fsdp axis, bf16 policy on TPU.  On CPU, run with
+``--simulate-devices 8`` to exercise the dp x sp mesh exactly as a pod
+would (SURVEY.md §4: simulated-multidevice testing is the TPU-world
+answer to "test multi-node without a cluster").
+
+Run:  python 06_lm_sequence_parallel.py --attn ulysses --seq-len 512 \
+          --simulate-devices 8
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from _common import base_parser
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class SyntheticTokenDataset:
+    """Deterministic next-token streams with learnable structure: token
+    t+1 = (a * t + noise-free affine walk) mod vocab, keyed by index."""
+
+    def __init__(self, n: int, seq_len: int, vocab: int, seed: int = 0):
+        self.n, self.seq_len, self.vocab, self.seed = n, seq_len, vocab, seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i: int):
+        rng = np.random.default_rng(self.seed * 100_003 + i)
+        start = int(rng.integers(0, self.vocab))
+        stride = int(rng.integers(1, 7))
+        toks = (start + stride * np.arange(self.seq_len + 1)) % self.vocab
+        return toks.astype(np.int32)
+
+
+def train(args) -> dict:
+    from tpuframe.core import runtime as rt
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.models import TransformerLM
+    from tpuframe.parallel import ZeroConfig, bf16_compute, full_precision
+    from tpuframe.train import (
+        create_train_state,
+        make_train_step,
+        merge_metrics,
+        summarize_metrics,
+        warmup_cosine,
+    )
+
+    # dp x sp mesh: batch over data, sequence over seq
+    runtime = rt.initialize(MeshSpec(data=-1, seq=args.seq_shards))
+    plan = ZeroConfig(stage=args.zero_stage).plan(runtime.mesh)
+    policy = bf16_compute() if runtime.platform == "tpu" else full_precision()
+
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        head_dim=args.head_dim,
+        max_len=args.seq_len,
+        attn_impl=args.attn,
+        dtype=policy.compute_dtype,
+    )
+    total_steps = args.epochs * (args.train_samples // args.batch_size)
+    state = create_train_state(
+        model,
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, args.seq_len), jnp.int32),
+        optax.adamw(warmup_cosine(args.lr, max(total_steps // 10, 1), total_steps)),
+        plan=plan,
+    )
+    step = make_train_step(policy)
+
+    ds = SyntheticTokenDataset(args.train_samples, args.seq_len, args.vocab,
+                               seed=args.seed)
+    steps_per_epoch = args.train_samples // args.batch_size
+    history = []
+    order_rng = np.random.default_rng(args.seed)
+    for epoch in range(args.epochs):
+        order = order_rng.permutation(len(ds))
+        acc = None
+        for b in range(steps_per_epoch):
+            idx = order[b * args.batch_size : (b + 1) * args.batch_size]
+            toks = np.stack([ds[int(i)] for i in idx])  # (B, L+1)
+            batch = plan.shard_batch(
+                {"input": toks[:, :-1], "label": toks[:, 1:]}
+            )
+            state, metrics = step(state, batch)
+            acc = merge_metrics(acc, metrics)
+        summary = summarize_metrics(acc, prefix="train_")
+        history.append(summary)
+        print(
+            f"epoch {epoch}: loss {summary['train_loss']:.4f} "
+            f"acc {summary['train_accuracy']:.3f} (attn={args.attn})",
+            flush=True,
+        )
+    return history[-1]
+
+
+def main(argv=None):
+    p = base_parser("Long-context LM with ring/Ulysses sequence parallelism")
+    p.add_argument("--attn", default="ring",
+                   choices=["ring", "ulysses", "full", "auto"])
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--seq-shards", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=16)
+    p.add_argument("--zero-stage", type=int, default=1)
+    args = p.parse_args(argv)
+    if args.simulate_devices:
+        from tpuframe.core.runtime import simulate_cpu_devices
+
+        simulate_cpu_devices(args.simulate_devices)
+    final = train(args)
+    assert np.isfinite(final["train_loss"])
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -110,3 +110,15 @@ def test_imagenet1k_zero_config(tmp_path):
         tmp_path=tmp_path,
     )
     assert "'stage': 3" in out and "'grad_accum': 2" in out
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_lm_sequence_parallel(tmp_path, attn):
+    # dp x sp mesh on 2 virtual devices: seq axis gets both
+    out = run_example(
+        "06_lm_sequence_parallel.py",
+        "--attn", attn, "--seq-shards", "2", "--seq-len", "64",
+        "--heads", "4", "--layers", "1",
+        tmp_path=tmp_path,
+    )
+    assert f"attn={attn}" in out

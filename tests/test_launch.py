@@ -337,3 +337,26 @@ def test_trainer_metrics_process_count_invariant():
     # (the old bug multiplied by process_count)
     assert 0 < double["train_samples_per_sec"]
     assert double["train_samples_per_sec"] < single["train_samples_per_sec"] * 10
+
+
+def test_result_history_tolerates_truncated_line(tmp_path):
+    """A worker killed mid-append leaves a partial jsonl line; fit() and a
+    refit must both survive it (Result.error contract, ADVICE follow-up)."""
+    def loop(config):
+        report({"x": 1.0})
+
+    def fit():
+        return TPUTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=str(tmp_path), name="trunc"),
+        ).fit()
+
+    result = fit()
+    assert result.error is None
+    # simulate the mid-append kill
+    with open(tmp_path / "trunc" / "rank_0.jsonl", "a") as f:
+        f.write('{"time": 1, "metrics": {"x"')
+    second = fit()  # refit rewrite + history read must both tolerate it
+    assert second.error is None
+    assert second.metrics == {"x": 1.0}

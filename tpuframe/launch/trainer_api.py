@@ -284,8 +284,16 @@ class TPUTrainer:
         try:
             with open(path) as f:
                 for line in f:
-                    if line.strip():
+                    if not line.strip():
+                        continue
+                    try:
                         out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # truncated tail from a worker killed mid-append:
+                        # surface what was durably recorded, don't crash
+                        # the driver (fit()'s contract: worker failure
+                        # lands in Result.error, never a driver raise)
+                        continue
         except FileNotFoundError:
             pass
         return out
