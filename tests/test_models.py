@@ -203,3 +203,66 @@ class TestViT:
         result = tr.fit()
         assert result.error is None
         assert np.isfinite(result.metrics["train_loss"])
+
+    def test_vit_tp_rules_shard_and_match(self):
+        """ViT forward with TP-sharded params == unsharded (rules engage on
+        QKV/MLP/patch-embed/head; XLA inserts the collectives)."""
+        from tpuframe.core import MeshSpec
+        from tpuframe.models import ViT, vit_tp_rules
+        from tpuframe.parallel import ParallelPlan
+
+        mesh = MeshSpec(data=2, model=4).build()
+        plan = ParallelPlan(mesh=mesh, rules=vit_tp_rules(), min_shard_elems=1)
+        model = ViT(num_classes=8, patch_size=4, hidden_dim=32, num_layers=2,
+                    num_heads=4, attn_impl="full")
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((4, 16, 16, 3)),
+            jnp.float32,
+        )
+        variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+        want = model.apply(variables, x)
+        sharded = plan.shard_params(variables["params"])
+        specs = {
+            "/".join(str(k.key) for k in path): leaf.sharding.spec
+            for path, leaf in jax.tree_util.tree_flatten_with_path(sharded)[0]
+        }
+        assert any("model" in str(s) for s in specs.values()), specs
+        assert "model" in str(specs["patch_embed/kernel"])
+        got = jax.jit(lambda p, x: model.apply({"params": p}, x))(sharded, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_torch_resnet_export_inverts_import(rng):
+    """export(import(sd)) == sd (minus num_batches_tracked), and exporting
+    freshly-initialized tpuframe variables yields loadable torch keys."""
+    from tpuframe.models.interop import export_torch_resnet, import_torch_resnet
+
+    model = ResNet18(num_classes=10)
+    variables = model.init(rng, jnp.zeros((1, 32, 32, 3)))
+    sd = export_torch_resnet(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]}
+    )
+    # torchvision-style names and torch layouts
+    assert sd["conv1.weight"].shape[2:] == (3, 3) or sd["conv1.weight"].shape[0] == 64
+    assert sd["fc.weight"].shape == (10, 512)
+    assert "layer1.0.conv1.weight" in sd
+    assert "bn1.running_mean" in sd
+    assert not any(k.endswith("num_batches_tracked") for k in sd)
+
+    back = import_torch_resnet(sd)
+    flat_a = jax.tree_util.tree_leaves_with_path(variables["params"])
+    flat_b = jax.tree_util.tree_leaves_with_path(back["params"])
+    assert len(flat_a) == len(flat_b)
+    for (pa, la), (pb, lb) in zip(flat_a, flat_b):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(variables["batch_stats"]),
+        jax.tree_util.tree_leaves_with_path(back["batch_stats"]),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    # downsample blocks map both ways
+    assert "layer2.0.downsample.0.weight" in sd
+    assert "downsample_conv" in back["params"]["layer2_0"]

@@ -21,7 +21,7 @@ from tpuframe.models.resnet import (
 )
 from tpuframe.models.norm import ReplicaGroupedBatchNorm
 from tpuframe.models.transfer import TransferClassifier, backbone_frozen_labels
-from tpuframe.models.vit import ViT, ViT_B16, ViT_S16
+from tpuframe.models.vit import ViT, ViT_B16, ViT_S16, vit_tp_rules
 
 __all__ = [
     "MnistNet",
@@ -38,6 +38,7 @@ __all__ = [
     "ViT",
     "ViT_S16",
     "ViT_B16",
+    "vit_tp_rules",
     "TransferClassifier",
     "backbone_frozen_labels",
 ]

@@ -1,4 +1,4 @@
-"""Torch checkpoint import: torchvision-style ResNet state_dicts -> flax params.
+"""Torch checkpoint interop: torchvision-style ResNet state_dicts <-> flax params.
 
 The reference's transfer-learning examples start from torchvision pretrained
 weights (`models.resnet18(weights=ResNet18_Weights.DEFAULT)` at
@@ -15,6 +15,10 @@ Layout conversions:
 - Linear: torch (out, in) -> flax (in, out)
 - BatchNorm: weight/bias -> scale/bias (params); running_mean/var -> mean/var
   (batch_stats collection)
+
+:func:`export_torch_resnet` is the exact inverse — a tpuframe-trained
+ResNet leaves as a torchvision-format state_dict, so users moving back to
+the reference stack (or serving with torch) keep their weights.
 """
 
 from __future__ import annotations
@@ -73,6 +77,51 @@ def import_torch_resnet(state_dict: Mapping[str, Any]) -> dict:
             put(params, mods + [leaf_name], array)
 
     return {"params": params, "batch_stats": batch_stats}
+
+
+def export_torch_resnet(variables: Mapping[str, Any]) -> dict:
+    """Convert tpuframe ResNet variables back to a torchvision-format
+    state_dict (numpy values; wrap with ``torch.from_numpy`` to load into
+    a torch module).  Exact inverse of :func:`import_torch_resnet`:
+    ``export(import(sd)) == sd`` up to the dropped ``num_batches_tracked``
+    counters, and round-tripping tpuframe variables is the identity.
+    """
+    params = variables.get("params", {})
+    batch_stats = variables.get("batch_stats", {})
+    out: dict[str, np.ndarray] = {}
+
+    def torch_module_name(mod: str) -> str:
+        # layer{i}_{j} -> layer{i}.{j}; downsample_{conv,bn} -> downsample.{0,1}
+        m = re.fullmatch(r"(layer\d+)_(\d+)", mod)
+        return f"{m.group(1)}.{m.group(2)}" if m else mod
+
+    def walk(tree: Mapping[str, Any], prefix: list[str], stats: bool) -> None:
+        for name, value in tree.items():
+            if isinstance(value, Mapping):
+                walk(value, prefix + [name], stats)
+                continue
+            arr = np.asarray(value)
+            mods = [torch_module_name(m) for m in prefix]
+            if mods and mods[-1] == "downsample_conv":
+                mods[-1] = "downsample.0"
+            elif mods and mods[-1] == "downsample_bn":
+                mods[-1] = "downsample.1"
+            module = ".".join(mods)
+            is_bn = bool(re.search(r"bn|downsample\.1", module))
+            if stats:
+                attr = {"mean": "running_mean", "var": "running_var"}[name]
+            elif is_bn:
+                attr = {"scale": "weight", "bias": "bias"}[name]
+            elif name == "kernel":
+                attr = "weight"
+                arr = arr.transpose(3, 2, 0, 1) if arr.ndim == 4 else arr.T
+            else:
+                attr = name
+            out[f"{module}.{attr}"] = arr
+
+    walk(params, [], stats=False)
+    walk(batch_stats, [], stats=True)
+    return out
 
 
 def _convert_leaf(module: str, attr: str, value: np.ndarray):

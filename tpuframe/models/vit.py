@@ -116,6 +116,25 @@ class ViT(nn.Module):
         return x.astype(jnp.float32)
 
 
+def vit_tp_rules():
+    """ParallelPlan TP rules for ViT: the shared transformer Block rules
+    (column-parallel QKV/mlp_in, row-parallel attn_out/mlp_out) plus the
+    patch embedding's output channels and the classifier head on the
+    model axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpuframe.core.runtime import MODEL_AXIS
+    from tpuframe.models.transformer import transformer_tp_rules
+
+    block_rules = tuple(
+        r for r in transformer_tp_rules() if "embed" not in r[0] and "lm_head" not in r[0]
+    )
+    return block_rules + (
+        (r"patch_embed/kernel", P(None, None, None, MODEL_AXIS)),
+        (r"head/kernel", P(None, MODEL_AXIS)),
+    )
+
+
 #: Standard recipes (patch 16): S ≈ 22M, B ≈ 86M params.
 ViT_S16 = functools.partial(ViT, hidden_dim=384, num_layers=12, num_heads=6)
 ViT_B16 = functools.partial(ViT, hidden_dim=768, num_layers=12, num_heads=12)
