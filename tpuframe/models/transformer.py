@@ -31,6 +31,7 @@ from tpuframe.core.runtime import (
     current_runtime,
 )
 from tpuframe.ops.ring_attention import attention_reference, ring_attention_local
+from tpuframe.ops.layer_norm import FusedLayerNorm
 from tpuframe.ops.ulysses import ulysses_attention_local
 
 
@@ -131,11 +132,17 @@ class Block(nn.Module):
     causal: bool = True
     attn_impl: str = "auto"
     dtype: Any = jnp.float32
+    #: False when the block runs inside an existing shard_map (GPipe):
+    #: the fused LN must not open a nested shard_map there.
+    ln_use_mesh: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         d = x.shape[-1]
-        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        ln = lambda name: FusedLayerNorm(  # noqa: E731
+            dtype=self.dtype, use_mesh=self.ln_use_mesh, name=name
+        )
+        y = ln("ln1")(x)
         y = SelfAttention(
             self.num_heads, self.head_dim, causal=self.causal,
             attn_impl=self.attn_impl, dtype=self.dtype, name="attn",
@@ -143,7 +150,7 @@ class Block(nn.Module):
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = ln("ln2")(x)
         y = nn.Dense(
             d * self.mlp_ratio, dtype=self.dtype, name="mlp_in"
         )(y)
@@ -181,7 +188,7 @@ class TransformerLM(nn.Module):
                 dropout=self.dropout, causal=True, attn_impl=self.attn_impl,
                 dtype=self.dtype, name=f"block{i}",
             )(x, train=train)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = FusedLayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(
             self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
         )(x)

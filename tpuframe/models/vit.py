@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from tpuframe.models.transformer import Block
+from tpuframe.ops.layer_norm import FusedLayerNorm
 
 
 class ViT(nn.Module):
@@ -108,7 +109,7 @@ class ViT(nn.Module):
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x, train=train)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = FusedLayerNorm(dtype=self.dtype, name="ln_f")(x)
 
         x = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
         if self.num_classes:

@@ -26,6 +26,11 @@ from tpuframe.ops.cross_entropy import (
     cross_entropy_reference,
 )
 from tpuframe.ops.fused_adamw import fused_adamw, fused_adamw_update
+from tpuframe.ops.layer_norm import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    layer_norm_reference,
+)
 from tpuframe.ops.ulysses import ulysses_attention, ulysses_attention_local
 from tpuframe.ops.ring_attention import (
     attention_reference,
@@ -39,6 +44,9 @@ __all__ = [
     "ring_attention_local",
     "ulysses_attention",
     "ulysses_attention_local",
+    "FusedLayerNorm",
+    "fused_layer_norm",
+    "layer_norm_reference",
     "use_pallas",
     "normalize_images",
     "normalize_images_reference",

@@ -192,6 +192,7 @@ class PipelinedTransformerLM:
         self._block = Block(
             self.num_heads, self.head_dim, mlp_ratio=self.mlp_ratio,
             causal=True, attn_impl="full", dtype=self.dtype,
+            ln_use_mesh=False,  # runs inside gpipe's shard_map already
         )
 
     # -- flax-like contract -------------------------------------------------
