@@ -33,6 +33,7 @@ from tpuframe.launch import ZeroDistributor
 from tpuframe.models import ResNet18
 from tpuframe.parallel import ZeroConfig
 from tpuframe.train import (
+    schedule_from_config,
     create_train_state,
     make_eval_step,
     make_train_step,
@@ -69,8 +70,16 @@ def train_zero(cfg: dict, zero_config: ZeroConfig | None = None):
     val_loader = DataLoader(val_ds, cfg["batch_size"], drop_last=False)
 
     model = ResNet18(num_classes=cfg["num_classes"], stem="cifar")
-    # AdamW + warmup like the base config (`deepspeed_config.py:28-40`)
-    schedule = optax.linear_schedule(0.0, cfg["lr"], cfg["warmup_steps"])
+    # AdamW + WarmupLR from the reference's exact scheduler block
+    # (`deepspeed_config.py:33-40`), resolved by the schedule library
+    schedule = schedule_from_config({
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0, "warmup_max_lr": cfg["lr"],
+                       "warmup_num_steps": cfg["warmup_steps"],
+                       "warmup_type": "linear"},
+        }
+    })
     state = create_train_state(
         model, jax.random.PRNGKey(cfg["seed"]),
         jnp.ones((1, cfg["image_size"], cfg["image_size"], 3)),

@@ -42,6 +42,7 @@ from tpuframe.launch import ZeroDistributor
 from tpuframe.models import ResNet50
 from tpuframe.parallel import ZeroConfig, align_model_dtype, bf16_compute, full_precision
 from tpuframe.train import (
+    schedule_from_config,
     create_train_state,
     make_eval_step,
     make_grad_accum_step,
@@ -74,8 +75,16 @@ def train_imagenet1k(cfg: dict, zero_config: ZeroConfig | None = None):
 
     policy = bf16_compute() if rt.platform == "tpu" else full_precision()
     model = align_model_dtype(ResNet50(num_classes=cfg["num_classes"]), policy)
-    # AdamW + linear warmup, the base-config optimizer (`deepspeed_config.py:28-40`)
-    schedule = optax.linear_schedule(0.0, cfg["lr"], cfg["warmup_steps"])
+    # AdamW + WarmupLR from the reference's exact scheduler block
+    # (`deepspeed_config.py:33-40`), resolved by the schedule library
+    schedule = schedule_from_config({
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0, "warmup_max_lr": cfg["lr"],
+                       "warmup_num_steps": cfg["warmup_steps"],
+                       "warmup_type": "linear"},
+        }
+    })
     state = create_train_state(
         model, jax.random.PRNGKey(cfg["seed"]),
         jnp.ones((1, cfg["image_size"], cfg["image_size"], 3)),
