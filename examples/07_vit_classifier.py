@@ -1,0 +1,93 @@
+"""ViT image classification through the high-level Trainer.
+
+Extends the acceptance suite beyond the reference's ResNet-only zoo
+(SURVEY.md §2.1 C6/C8) with the other standard image backbone, driven
+exactly like the Composer recipe (`03_composer_cifar_resnet.py`): the
+Composer-shaped Trainer with duration strings, LabelSmoothing/MixUp
+algorithms, a cosine schedule from the schedule library, bf16 on TPU,
+and best-checkpoint tracking.  Tensor parallelism is one flag away
+(``--tp`` shards QKV/MLP/patch-embed/head via ``vit_tp_rules``).
+
+Run:  python 07_vit_classifier.py --epochs 2 --simulate-devices 4 --tp 2
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from _common import base_parser, make_datasets
+
+
+def train(args) -> dict:
+    from tpuframe.core import runtime as rt
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.data import DataLoader
+    from tpuframe.models import ViT, vit_tp_rules
+    from tpuframe.parallel import ParallelPlan
+    from tpuframe.train import LabelSmoothing, MixUp, Trainer, cosine_annealing
+
+    runtime = rt.initialize(MeshSpec(data=-1, model=args.tp))
+    plan = ParallelPlan(
+        mesh=runtime.mesh,
+        rules=vit_tp_rules() if args.tp > 1 else (),
+        min_shard_elems=1,
+    )
+
+    train_ds, eval_ds = make_datasets(args)
+    train_loader = DataLoader(
+        train_ds, args.batch_size, shuffle=True, seed=args.seed
+    )
+    eval_loader = DataLoader(eval_ds, args.batch_size, drop_last=False)
+
+    steps = args.epochs * max(len(train_loader), 1)
+    trainer = Trainer(
+        ViT(
+            num_classes=args.num_classes,
+            patch_size=args.patch_size,
+            hidden_dim=args.hidden_dim,
+            num_layers=args.layers,
+            num_heads=args.heads,
+            attn_impl="full",
+        ),
+        train_dataloader=train_loader,
+        eval_dataloader=eval_loader,
+        max_duration=args.epochs,
+        optimizer="adamw",
+        lr=cosine_annealing(args.lr, steps),
+        algorithms=[LabelSmoothing(0.1), MixUp()],
+        precision="bf16" if runtime.platform == "tpu" else "fp32",
+        plan=plan,
+        seed=args.seed,
+        log_interval=0,
+    )
+    result = trainer.fit()
+    if result.error is not None:
+        raise result.error
+    print(
+        f"final: loss {result.metrics['train_loss']:.4f} "
+        f"eval_acc {result.metrics.get('eval_accuracy', float('nan')):.3f} "
+        f"(tp={args.tp})",
+        flush=True,
+    )
+    return result.metrics
+
+
+def main(argv=None):
+    p = base_parser("ViT classifier via the high-level Trainer (+ optional TP)")
+    p.add_argument("--patch-size", type=int, default=4)
+    p.add_argument("--hidden-dim", type=int, default=64)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--tp", type=int, default=1)
+    args = p.parse_args(argv)
+    if args.simulate_devices:
+        from tpuframe.core.runtime import simulate_cpu_devices
+
+        simulate_cpu_devices(args.simulate_devices)
+    metrics = train(args)
+    assert np.isfinite(metrics["train_loss"])
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

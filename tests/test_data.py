@@ -315,3 +315,37 @@ def test_synthetic_transform_rng_uses_seed_and_epoch():
     ds.set_epoch(1); ds[0]; e1_idx0 = spy.last
     ds.set_epoch(0); ds[2]; e0_idx2 = spy.last
     assert e1_idx0 not in (e0, e0_idx2)  # epochs don't alias neighboring indices
+
+
+def test_torch_dataset_plugs_into_dataloader():
+    """A plain torch.utils.data.Dataset works as-is: the DataLoader's
+    contract is __len__/__getitem__ -> (img, label), exactly the map-style
+    dataset the reference builds (`utils/hf_dataset_utilities.py:24-56`) —
+    users switching keep their torch Dataset classes unchanged."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import Dataset
+
+    from tpuframe.data import DataLoader
+
+    class TorchImages(Dataset):
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            g = torch.Generator().manual_seed(i)
+            img = torch.rand((8, 8, 3), generator=g)
+            return img.numpy(), i % 4
+
+    loader = DataLoader(TorchImages(), batch_size=8, shuffle=True, seed=0)
+    batches = list(loader)
+    assert len(batches) == 3
+    images, labels = batches[0]
+    assert images.shape == (8, 8, 8, 3) and labels.shape == (8,)
+    assert images.dtype == np.float32
+    # epoch-dependent shuffling: a new epoch reorders, returning restores
+    loader.set_epoch(1)
+    other = list(loader)
+    assert not np.array_equal(other[0][0], images)
+    loader.set_epoch(0)
+    again = list(loader)
+    np.testing.assert_array_equal(again[0][0], images)

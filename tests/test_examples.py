@@ -122,3 +122,13 @@ def test_lm_sequence_parallel(tmp_path, attn):
         tmp_path=tmp_path,
     )
     assert f"attn={attn}" in out
+
+
+def test_vit_classifier_with_tp(tmp_path):
+    out = run_example(
+        "07_vit_classifier.py",
+        "--tp", "2", "--layers", "2", "--hidden-dim", "32", "--heads", "4",
+        "--simulate-devices", "2",
+        tmp_path=tmp_path,
+    )
+    assert "tp=2" in out

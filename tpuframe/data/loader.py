@@ -127,8 +127,11 @@ class DataLoader:
         tail = len(indices) % self.local_batch_size
 
         pool = ThreadPoolExecutor(self.num_workers) if self.num_workers else None
-        fetch = (lambda idxs: list(pool.map(self.dataset.__getitem__, idxs))) if pool \
-            else (lambda idxs: [self.dataset[i] for i in idxs])
+        # plain Python ints: torch-style datasets (the reference's map-style
+        # Dataset contract) often reject numpy integer indices
+        get = lambda i: self.dataset[int(i)]  # noqa: E731
+        fetch = (lambda idxs: list(pool.map(get, idxs))) if pool \
+            else (lambda idxs: [get(i) for i in idxs])
         try:
             for b in range(nb_full):
                 sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)
