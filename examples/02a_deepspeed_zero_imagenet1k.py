@@ -33,7 +33,6 @@ sys.path.insert(0, os.path.dirname(__file__))
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from _common import base_parser
 from tpuframe import core
@@ -42,7 +41,7 @@ from tpuframe.launch import ZeroDistributor
 from tpuframe.models import ResNet50
 from tpuframe.parallel import ZeroConfig, align_model_dtype, bf16_compute, full_precision
 from tpuframe.train import (
-    schedule_from_config,
+    optimizer_from_config,
     create_train_state,
     make_eval_step,
     make_grad_accum_step,
@@ -75,20 +74,27 @@ def train_imagenet1k(cfg: dict, zero_config: ZeroConfig | None = None):
 
     policy = bf16_compute() if rt.platform == "tpu" else full_precision()
     model = align_model_dtype(ResNet50(num_classes=cfg["num_classes"]), policy)
-    # AdamW + WarmupLR from the reference's exact scheduler block
-    # (`deepspeed_config.py:33-40`), resolved by the schedule library
-    schedule = schedule_from_config({
+    # The reference's whole base-config optimizer stack consumed as one
+    # dict (`deepspeed_config.py:14-40`): AdamW betas/eps + WarmupLR
+    # schedule + the gradient_clipping knob the reference sets but never
+    # engages (`shared_parameters["gradient_clipping"]`)
+    tx = optimizer_from_config({
+        "gradient_clipping": 0.3,
+        "optimizer": {
+            "type": "AdamW",
+            "params": {"lr": cfg["lr"], "betas": [0.9, 0.999], "eps": 1e-08},
+        },
         "scheduler": {
             "type": "WarmupLR",
             "params": {"warmup_min_lr": 0, "warmup_max_lr": cfg["lr"],
                        "warmup_num_steps": cfg["warmup_steps"],
                        "warmup_type": "linear"},
-        }
+        },
     })
     state = create_train_state(
         model, jax.random.PRNGKey(cfg["seed"]),
         jnp.ones((1, cfg["image_size"], cfg["image_size"], 3)),
-        optax.adamw(schedule), plan=plan, init_kwargs={"train": False},
+        tx, plan=plan, init_kwargs={"train": False},
     )
     accum = cfg["grad_accum"]
     if accum > 1:

@@ -23,6 +23,7 @@ from tpuframe.train.schedules import (
     warmup_decay_lr,
     warmup_lr,
 )
+from tpuframe.train.optim import optimizer_from_config
 from tpuframe.train.schedules import from_config as schedule_from_config
 from tpuframe.train.state import TrainState, create_train_state, param_count
 from tpuframe.train.step import (
@@ -54,6 +55,7 @@ __all__ = [
     "cosine_annealing",
     "step_decay",
     "schedule_from_config",
+    "optimizer_from_config",
     "TrainState",
     "create_train_state",
     "param_count",

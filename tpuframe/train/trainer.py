@@ -119,6 +119,7 @@ class Trainer:
         log_interval: int = 10,
         report: Callable[[dict, str | None], None] | None = None,
         grad_accum: int = 1,
+        grad_clip: float | None = None,
         normalize: tuple | None = None,
     ):
         if precision is None:
@@ -159,6 +160,15 @@ class Trainer:
 
         if tx is None:
             tx = _make_optimizer(optimizer, self._resolve_lr(lr))
+            if grad_clip:
+                # DeepSpeed's gradient_clipping knob (`deepspeed_config.py:18`):
+                # global-norm clip chained before the update
+                tx = optax.chain(optax.clip_by_global_norm(float(grad_clip)), tx)
+        elif grad_clip:
+            raise ValueError(
+                "grad_clip only applies when the Trainer builds the optimizer "
+                "(tx=None); chain optax.clip_by_global_norm into your tx instead"
+            )
         self.tx = tx
 
         if num_classes is None:
