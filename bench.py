@@ -175,6 +175,40 @@ def _run_bench() -> None:
     )
 
 
+_PREFLIGHT_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "y = jax.jit(lambda a: a @ a)(jnp.ones((128, 128))); "
+    "y.block_until_ready(); print('PREFLIGHT_OK', jax.default_backend())"
+)
+
+
+def _preflight(env: dict, timeout_s: float = 300.0) -> tuple[str, str]:
+    """Can this environment compile+run a trivial program in bounded time?
+
+    Guards against a *wedged* backend (e.g. the TPU tunnel's remote-compile
+    helper down: compiles hang forever rather than erroring) — without
+    this, each full-bench attempt would burn its whole child timeout
+    before the ladder falls back to CPU.
+
+    Returns ``(verdict, detail)``: ``"ok"`` | ``"hang"`` (deterministic
+    wedge — poison the rung) | ``"fail"`` (fast error — possibly
+    transient, the backoff retry rung should still get its chance).
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PREFLIGHT_SRC],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "hang", f"preflight compile hung > {timeout_s:.0f}s"
+    if proc.returncode == 0 and "PREFLIGHT_OK" in proc.stdout:
+        return "ok", ""
+    return "fail", (proc.stderr or proc.stdout or "").strip()[-500:]
+
+
 def _last_json_line(text: str) -> str | None:
     for line in reversed(text.strip().splitlines()):
         line = line.strip()
@@ -198,7 +232,11 @@ def main() -> None:
         ({}, 0.0),
         ({}, 15.0),
         ({"JAX_PLATFORMS": ""}, 5.0),  # let jax auto-pick what's available
-        ({"JAX_PLATFORMS": "cpu"}, 0.0),  # guaranteed degraded fallback
+        # Guaranteed degraded fallback.  Clearing PALLAS_AXON_POOL_IPS
+        # matters: this image's sitecustomize re-pins the TPU platform
+        # whenever that var is set, overriding JAX_PLATFORMS=cpu — the
+        # CPU rung would otherwise die on the same broken TPU backend.
+        ({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}, 0.0),
     ]
     last_err = ""
     timed_out: set[str] = set()
@@ -212,6 +250,19 @@ def main() -> None:
         if pre_sleep:
             time.sleep(pre_sleep)
         env = {**os.environ, **extra, _CHILD_ENV: "1"}
+        # tiny-compile preflight (skipped for the guaranteed-CPU rung):
+        # a wedged accelerator backend hangs compiles instead of erroring,
+        # and must not consume a full bench-child timeout per attempt.
+        if extra.get("JAX_PLATFORMS") != "cpu":
+            verdict, detail = _preflight(env)
+            if verdict != "ok":
+                last_err = f"preflight ({extra or 'default env'}): {detail}"
+                if verdict == "hang":
+                    # deterministic wedge: don't re-burn this backend; a
+                    # fast *failure* stays retryable (attempt 2's backoff
+                    # exists for exactly the transient-init case)
+                    timed_out.add(effective)
+                continue
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],

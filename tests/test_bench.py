@@ -1,0 +1,129 @@
+"""bench.py ladder semantics: preflight tri-state, retry preservation,
+wedge poisoning, and the never-rc-1 labeled-failure contract."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(_ROOT, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod
+
+
+def _proc(rc=0, out="", err=""):
+    return types.SimpleNamespace(returncode=rc, stdout=out, stderr=err)
+
+
+class _Runner:
+    """Scripted subprocess.run replacement; records the attempt sequence."""
+
+    def __init__(self, script):
+        self.script = list(script)  # per-call outcomes
+        self.calls = []  # ("preflight"|"child", JAX_PLATFORMS value)
+
+    def __call__(self, cmd, env=None, timeout=None, **kw):
+        kind = "preflight" if cmd[1] == "-c" else "child"
+        self.envs = getattr(self, "envs", []) + [env]
+        self.calls.append((kind, env.get("JAX_PLATFORMS", "<unset>")))
+        outcome = self.script.pop(0)
+        if outcome == "hang":
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        if outcome == "fail":
+            return _proc(rc=1, err="backend exploded")
+        if outcome == "ok-preflight":
+            return _proc(out="PREFLIGHT_OK tpu")
+        if outcome == "ok-child":
+            return _proc(out=json.dumps({"metric": "m", "value": 1.0}))
+        raise AssertionError(outcome)
+
+
+def _run_main(bench, monkeypatch, capsys, script, platform="axon"):
+    runner = _Runner(script)
+    monkeypatch.setattr(bench.subprocess, "run", runner)
+    monkeypatch.setattr(bench.os, "environ", {"JAX_PLATFORMS": platform})
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return runner, json.loads(out)
+
+
+def test_wedged_backend_poisons_rung_and_falls_to_cpu(
+    bench, monkeypatch, capsys
+):
+    """Preflight hang on attempt 1 skips the backoff retry of the SAME
+    backend and the auto rung, landing on CPU — without burning any full
+    child timeout on the wedged backend."""
+    runner, rec = _run_main(
+        bench,
+        monkeypatch,
+        capsys,
+        # attempt1 preflight hangs; attempt2 (same backend) skipped;
+        # attempt3 ('' = auto) preflight hangs; attempt4 cpu child runs
+        ["hang", "hang", "ok-child"],
+    )
+    assert [k for k, _ in runner.calls] == ["preflight", "preflight", "child"]
+    assert runner.calls[-1][1] == "cpu"
+    assert rec["value"] == 1.0
+
+
+def test_fast_failure_keeps_backoff_retry(bench, monkeypatch, capsys):
+    """A transient init *error* (fast, not a hang) must not poison the
+    backend: attempt 2 retries it after backoff — the r01 failure mode."""
+    runner, rec = _run_main(
+        bench,
+        monkeypatch,
+        capsys,
+        # attempt1 preflight fails fast; attempt2 preflight ok, child ok
+        ["fail", "ok-preflight", "ok-child"],
+    )
+    assert [k for k, _ in runner.calls] == ["preflight", "preflight", "child"]
+    assert runner.calls[-1][1] == "axon"  # same backend, retried
+    assert rec["value"] == 1.0
+
+
+def test_total_failure_emits_labeled_record(bench, monkeypatch, capsys):
+    """Everything broken -> rc stays 0 and ONE parseable JSON line with
+    backend 'none' and the last real error, never a bare crash."""
+    runner, rec = _run_main(
+        bench,
+        monkeypatch,
+        capsys,
+        # both accelerator preflights fail fast (incl. retry), cpu child dies
+        ["fail", "fail", "fail", "fail"],
+    )
+    kinds = [k for k, _ in runner.calls]
+    assert kinds == ["preflight", "preflight", "preflight", "child"]
+    assert rec["backend"] == "none" and rec["value"] == 0.0
+    assert "error" in rec
+
+
+def test_cpu_rung_neutralizes_platform_pins(bench, monkeypatch, capsys):
+    """The CPU rung must clear the TPU-plugin env pin (sitecustomize
+    re-pins the platform off PALLAS_AXON_POOL_IPS) or it dies on the same
+    broken backend."""
+    runner = _Runner(["hang", "hang", "ok-child"])
+    monkeypatch.setattr(bench.subprocess, "run", runner)
+    monkeypatch.setattr(
+        bench.os,
+        "environ",
+        {"JAX_PLATFORMS": "axon", "PALLAS_AXON_POOL_IPS": "127.0.0.1"},
+    )
+    bench.main()
+    # the final (cpu) call must both select cpu AND clear the plugin pin
+    assert runner.calls[-1] == ("child", "cpu")
+    assert runner.envs[-1].get("PALLAS_AXON_POOL_IPS") == ""
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 1.0

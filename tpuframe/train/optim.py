@@ -48,6 +48,13 @@ _OPTIMIZERS = {
     "lamb": lambda lr, p: optax.lamb(
         lr, weight_decay=float(p.get("weight_decay", 0.0))
     ),
+    # not a DeepSpeed type, but keeps parity with Trainer's optimizer= names
+    "lion": lambda lr, p: optax.lion(
+        lr,
+        b1=float(p.get("betas", (0.9, 0.99))[0]),
+        b2=float(p.get("betas", (0.9, 0.99))[1]),
+        weight_decay=float(p.get("weight_decay", 0.0)),
+    ),
 }
 
 
