@@ -34,10 +34,11 @@ class _Runner:
     def __init__(self, script):
         self.script = list(script)  # per-call outcomes
         self.calls = []  # ("preflight"|"child", JAX_PLATFORMS value)
+        self.envs = []  # full env dict per call
 
     def __call__(self, cmd, env=None, timeout=None, **kw):
         kind = "preflight" if cmd[1] == "-c" else "child"
-        self.envs = getattr(self, "envs", []) + [env]
+        self.envs.append(env)
         self.calls.append((kind, env.get("JAX_PLATFORMS", "<unset>")))
         outcome = self.script.pop(0)
         if outcome == "hang":

@@ -266,6 +266,19 @@ class TestOptimizerFromConfig:
             {"optimizer": {"type": "SGD", "params": {"lr": 0.1, "momentum": 0.9}}}
         )
         assert tx.init({"w": jnp.ones(2)})
+        # lion: betas map through, default weight_decay matches bare optax
+        import optax
+
+        lion = optimizer_from_config(
+            {"optimizer": {"type": "Lion",
+                           "params": {"lr": 1e-2, "betas": [0.95, 0.98]}}}
+        )
+        ref = optax.lion(1e-2, b1=0.95, b2=0.98)
+        params = {"w": jnp.ones((3,))}
+        g = {"w": jnp.asarray([0.5, -0.2, 0.1])}
+        got, _ = lion.update(g, lion.init(params), params)
+        want, _ = ref.update(g, ref.init(params), params)
+        np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(want["w"]))
         with pytest.raises(ValueError, match="unknown optimizer"):
             optimizer_from_config({"optimizer": {"type": "Adafactor"}})
         with pytest.raises(ValueError, match="no scheduler"):

@@ -25,7 +25,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from tpuframe.models.transformer import Block
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import MODEL_AXIS
+from tpuframe.models.transformer import Block, transformer_tp_rules
 from tpuframe.ops.layer_norm import FusedLayerNorm
 
 
@@ -122,11 +125,6 @@ def vit_tp_rules():
     (column-parallel QKV/mlp_in, row-parallel attn_out/mlp_out) plus the
     patch embedding's output channels and the classifier head on the
     model axis."""
-    from jax.sharding import PartitionSpec as P
-
-    from tpuframe.core.runtime import MODEL_AXIS
-    from tpuframe.models.transformer import transformer_tp_rules
-
     block_rules = tuple(
         r for r in transformer_tp_rules() if "embed" not in r[0] and "lm_head" not in r[0]
     )

@@ -20,9 +20,16 @@ import functools
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
+from tpuframe.core.runtime import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    current_runtime,
+)
 from tpuframe.ops.dispatch import batch_sharding_info, pad_to, resolve_interpret
 
 _ROWS = 16
@@ -152,8 +159,6 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 
 def _spec_shard_info(mesh, spec, shape):
     """(total_shards, divisible) for an x PartitionSpec over lead dims."""
-    import numpy as np
-
     total, ok = 1, True
     for dim, entry in zip(shape[:-1], tuple(spec)[:-1]):
         if entry is None:
@@ -253,13 +258,6 @@ class FusedLayerNorm(nn.Module):
         bias = self.param("bias", nn.initializers.zeros, (d,), jnp.float32)
         mesh = spec = None
         if self.use_mesh and not self.is_initializing():
-            from tpuframe.core.runtime import (
-                DATA_AXIS,
-                FSDP_AXIS,
-                SEQUENCE_AXIS,
-                current_runtime,
-            )
-
             try:
                 mesh = current_runtime(auto_init=False).mesh
             except RuntimeError:

@@ -40,6 +40,16 @@ def _adam(lr, p):
     return optax.adam(lr, b1=float(b1), b2=float(b2), eps=float(p.get("eps", 1e-8)))
 
 
+def _lion(lr, p):
+    b1, b2 = p.get("betas", (0.9, 0.99))
+    # default weight_decay matches bare optax.lion (1e-3), so the
+    # config path and Trainer(optimizer="lion") train identically
+    return optax.lion(
+        lr, b1=float(b1), b2=float(b2),
+        weight_decay=float(p.get("weight_decay", 1e-3)),
+    )
+
+
 #: single source of truth for supported types (error messages derive from it)
 _OPTIMIZERS = {
     "adamw": _adamw,
@@ -49,12 +59,7 @@ _OPTIMIZERS = {
         lr, weight_decay=float(p.get("weight_decay", 0.0))
     ),
     # not a DeepSpeed type, but keeps parity with Trainer's optimizer= names
-    "lion": lambda lr, p: optax.lion(
-        lr,
-        b1=float(p.get("betas", (0.9, 0.99))[0]),
-        b2=float(p.get("betas", (0.9, 0.99))[1]),
-        weight_decay=float(p.get("weight_decay", 0.0)),
-    ),
+    "lion": _lion,
 }
 
 

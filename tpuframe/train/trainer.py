@@ -37,6 +37,7 @@ from tpuframe.parallel.sharding import ParallelPlan
 from tpuframe.train.algorithms import Algorithm, apply_algorithms, resolve_algorithms
 from tpuframe.train.callbacks import Callback
 from tpuframe.train.duration import Duration
+from tpuframe.train.schedules import resolve_schedule
 from tpuframe.train.state import TrainState, create_train_state
 from tpuframe.train.step import (
     cross_entropy,
@@ -262,8 +263,6 @@ class Trainer:
         config carrying a ``"scheduler"`` key — `deepspeed_config.py:33-40`);
         ``total_num_steps: "auto"`` resolves against max_duration and the
         train dataloader."""
-        from tpuframe.train.schedules import resolve_schedule
-
         return resolve_schedule(
             lr,
             total_steps=_planned_total_steps(self.max_duration, self.train_dataloader),
