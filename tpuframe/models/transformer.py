@@ -161,8 +161,20 @@ class Block(nn.Module):
         return x + y
 
 
+#: Block with backward-pass rematerialization (jax.checkpoint); the static
+#: index pins ``train`` (arg 2: module, x, train) — single definition so
+#: callers can't drift from Block.__call__'s positional signature.
+RematBlock = nn.remat(Block, static_argnums=(2,))
+
+
 class TransformerLM(nn.Module):
-    """Decoder-only LM: (B, L) int tokens -> (B, L, vocab) logits."""
+    """Decoder-only LM: (B, L) int tokens -> (B, L, vocab) logits.
+
+    ``remat=True`` rematerializes each block in the backward pass
+    (``jax.checkpoint`` via ``nn.remat``): activation memory drops from
+    O(layers) to O(1) blocks at ~1/3 extra FLOPs — the standard trade
+    for long-context or memory-bound configs.  Numerics are identical.
+    """
 
     vocab_size: int
     num_layers: int = 4
@@ -173,6 +185,7 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     attn_impl: str = "auto"
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array, train: bool = False) -> jax.Array:
@@ -182,12 +195,13 @@ class TransformerLM(nn.Module):
             jnp.arange(tokens.shape[1])[None, :]
         )
         x = x + pos
+        block_cls = RematBlock if self.remat else Block
         for i in range(self.num_layers):
-            x = Block(
+            x = block_cls(
                 self.num_heads, self.head_dim, mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout, causal=True, attn_impl=self.attn_impl,
                 dtype=self.dtype, name=f"block{i}",
-            )(x, train=train)
+            )(x, train)
         x = FusedLayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(
             self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
