@@ -123,7 +123,13 @@ class SelfAttention(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm transformer block: LN -> attn -> +res, LN -> MLP -> +res."""
+    """Pre-norm transformer block: LN -> attn -> +res, LN -> MLP -> +res.
+
+    ``moe_experts > 0`` replaces the dense MLP with a top-k gated
+    MoE (GShard pattern): expert weights shard over the ``expert`` mesh
+    axis via ``moe_rules`` and the router's load-balancing loss rides the
+    ``aux_loss`` collection into the train objective.
+    """
 
     num_heads: int
     head_dim: int
@@ -135,6 +141,8 @@ class Block(nn.Module):
     #: False when the block runs inside an existing shard_map (GPipe):
     #: the fused LN must not open a nested shard_map there.
     ln_use_mesh: bool = True
+    moe_experts: int = 0
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -151,11 +159,19 @@ class Block(nn.Module):
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = ln("ln2")(x)
-        y = nn.Dense(
-            d * self.mlp_ratio, dtype=self.dtype, name="mlp_in"
-        )(y)
-        y = nn.gelu(y)
-        y = nn.Dense(d, dtype=self.dtype, name="mlp_out")(y)
+        if self.moe_experts:
+            from tpuframe.models.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=self.moe_experts, top_k=self.moe_top_k,
+                mlp_ratio=self.mlp_ratio, dtype=self.dtype, name="moe",
+            )(y, train=train)
+        else:
+            y = nn.Dense(
+                d * self.mlp_ratio, dtype=self.dtype, name="mlp_in"
+            )(y)
+            y = nn.gelu(y)
+            y = nn.Dense(d, dtype=self.dtype, name="mlp_out")(y)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return x + y
@@ -186,6 +202,10 @@ class TransformerLM(nn.Module):
     attn_impl: str = "auto"
     dtype: Any = jnp.float32
     remat: bool = False
+    #: >0 swaps every block's dense MLP for a top-k gated MoE (GShard);
+    #: compose with ParallelPlan(rules=moe_rules()) for expert parallelism
+    moe_experts: int = 0
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, tokens: jax.Array, train: bool = False) -> jax.Array:
@@ -200,7 +220,8 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 self.num_heads, self.head_dim, mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout, causal=True, attn_impl=self.attn_impl,
-                dtype=self.dtype, name=f"block{i}",
+                dtype=self.dtype, moe_experts=self.moe_experts,
+                moe_top_k=self.moe_top_k, name=f"block{i}",
             )(x, train)
         x = FusedLayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(
