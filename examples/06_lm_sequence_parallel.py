@@ -13,7 +13,9 @@ sharded over the mesh's ``seq`` axis:
 - ``--attn full``     no SP, the single-chip baseline.
 
 Composable with the rest of the ladder: ZeRO via ``--zero-stage`` shards
-optimizer state over the fsdp axis, bf16 policy on TPU.  On CPU, run with
+optimizer state over the fsdp axis; ``--moe-experts N`` swaps every
+block's MLP for a top-k gated MoE with expert weights sharded over the
+``expert`` axis (GShard SP x EP composition); bf16 policy on TPU.  On CPU, run with
 ``--simulate-devices 8`` to exercise the dp x sp mesh exactly as a pod
 would (SURVEY.md §4: simulated-multidevice testing is the TPU-world
 answer to "test multi-node without a cluster").
@@ -64,9 +66,18 @@ def train(args) -> dict:
         warmup_cosine,
     )
 
-    # dp x sp mesh: batch over data, sequence over seq
-    runtime = rt.initialize(MeshSpec(data=-1, seq=args.seq_shards))
-    plan = ZeroConfig(stage=args.zero_stage).plan(runtime.mesh)
+    # dp x sp (x ep) mesh: batch over data, sequence over seq, experts
+    # over expert when MoE is on
+    runtime = rt.initialize(
+        MeshSpec(data=-1, seq=args.seq_shards,
+                 expert=args.expert_shards if args.moe_experts else 1)
+    )
+    rules = ()
+    if args.moe_experts:
+        from tpuframe.models import moe_rules
+
+        rules = moe_rules()
+    plan = ZeroConfig(stage=args.zero_stage).plan(runtime.mesh, rules=rules)
     policy = bf16_compute() if runtime.platform == "tpu" else full_precision()
 
     model = TransformerLM(
@@ -77,6 +88,7 @@ def train(args) -> dict:
         max_len=args.seq_len,
         attn_impl=args.attn,
         dtype=policy.compute_dtype,
+        moe_experts=args.moe_experts,
     )
     total_steps = args.epochs * (args.train_samples // args.batch_size)
     state = create_train_state(
@@ -125,6 +137,8 @@ def main(argv=None):
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=16)
     p.add_argument("--zero-stage", type=int, default=1)
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--expert-shards", type=int, default=2)
     args = p.parse_args(argv)
     if args.simulate_devices:
         from tpuframe.core.runtime import simulate_cpu_devices

@@ -132,3 +132,27 @@ def test_vit_classifier_with_tp(tmp_path):
         tmp_path=tmp_path,
     )
     assert "tp=2" in out
+
+
+def test_lm_moe_sequence_parallel(tmp_path):
+    # SP + MoE blocks (2 devices only fit one sharded axis: seq here)
+    out = run_example(
+        "06_lm_sequence_parallel.py",
+        "--attn", "ring", "--seq-shards", "2", "--seq-len", "64",
+        "--heads", "4", "--layers", "1",
+        "--moe-experts", "2", "--expert-shards", "1",
+        tmp_path=tmp_path,
+    )
+    assert "attn=ring" in out
+
+
+def test_lm_moe_expert_parallel(tmp_path):
+    # real expert axis: both devices on expert -> moe_rules shard w_in/w_out
+    out = run_example(
+        "06_lm_sequence_parallel.py",
+        "--attn", "full", "--seq-shards", "1", "--seq-len", "64",
+        "--heads", "4", "--layers", "1",
+        "--moe-experts", "2", "--expert-shards", "2",
+        tmp_path=tmp_path,
+    )
+    assert "attn=full" in out
