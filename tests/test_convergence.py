@@ -1,0 +1,87 @@
+"""Convergence acceptance tests: models must actually LEARN, not just
+produce falling losses.
+
+The reference's de-facto validation ladder is local-smoke -> 1-epoch
+cheap run -> full run with accuracy watched by hand (SURVEY.md §4);
+these tests automate the "does it learn" rung with accuracy thresholds
+on deterministic synthetic tasks, so a silent optimizer/sharding/
+precision regression that merely slows divergence cannot pass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.core import MeshSpec
+from tpuframe.core import runtime as rt
+from tpuframe.data import DataLoader, SyntheticImageDataset
+from tpuframe.models import ResNet18, TransformerLM
+from tpuframe.parallel import ParallelPlan
+from tpuframe.train import (
+    Trainer,
+    create_train_state,
+    make_train_step,
+    merge_metrics,
+    summarize_metrics,
+)
+
+
+@pytest.mark.slow  # ~90 s; deselect with -m "not slow"
+def test_resnet_converges_on_learnable_vision_task():
+    """ResNet18 on the class-conditional synthetic images: >90% train
+    accuracy and clearly-above-chance eval in 6 epochs (chance = 25%)."""
+    ds = SyntheticImageDataset(n=256, image_size=16, num_classes=4, seed=0)
+    ev = SyntheticImageDataset(n=64, image_size=16, num_classes=4, seed=1)
+    trainer = Trainer(
+        ResNet18(num_classes=4, stem="cifar"),
+        train_dataloader=DataLoader(ds, batch_size=32, shuffle=True, seed=0),
+        eval_dataloader=DataLoader(ev, batch_size=32, drop_last=False),
+        max_duration="6ep",
+        lr=3e-3,
+        optimizer="adamw",
+        eval_interval=6,
+        log_interval=0,
+    )
+    result = trainer.fit()  # raises on failure; no error to inspect
+    assert result.metrics["train_accuracy"] > 0.9, result.metrics
+    assert result.metrics["eval_accuracy"] > 0.45, result.metrics  # 1.8x chance
+
+
+def test_transformer_lm_learns_deterministic_sequences():
+    """Next-token accuracy >80% on affine token streams in 60 steps —
+    the LM/attention/CE stack end to end, sharded over the mesh."""
+    rt.reset_runtime()
+    try:
+        rt.initialize(MeshSpec(data=-1))
+        plan = ParallelPlan(mesh=rt.current_runtime().mesh)
+        model = TransformerLM(
+            vocab_size=32, num_layers=2, num_heads=4, head_dim=8,
+            max_len=32, attn_impl="full",
+        )
+        rng = np.random.default_rng(0)
+
+        def make_batch(b=32):
+            start = rng.integers(0, 32, b)
+            stride = rng.integers(1, 4, b)
+            toks = (start[:, None] + stride[:, None] * np.arange(33)) % 32
+            return toks.astype(np.int32)
+
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32),
+            optax.adamw(3e-3), plan=plan,
+        )
+        step = make_train_step()
+        acc = None
+        for i in range(60):
+            t = make_batch()
+            batch = plan.shard_batch({"input": t[:, :-1], "label": t[:, 1:]})
+            state, metrics = step(state, batch)
+            if i >= 50:  # steady-state window
+                acc = merge_metrics(acc, metrics)
+        summary = summarize_metrics(acc, prefix="")
+        assert summary["accuracy"] > 0.8, summary
+        assert summary["loss"] < 0.8, summary
+    finally:
+        rt.reset_runtime()
