@@ -41,6 +41,16 @@ class TestWarmupLR:
         assert all(b >= a for a, b in zip(vals, vals[1:]))
         # log ramp is ahead of linear mid-warmup
         assert _f(s(10)) > 10 / 100
+        # exact DeepSpeed WarmupLR parity: log(step+1)/log(warmup_num_steps)
+        import math
+
+        assert _f(s(10)) == pytest.approx(math.log(11) / math.log(100), abs=1e-6)
+        assert _f(s(99)) == pytest.approx(1.0, abs=1e-6)
+
+    def test_log_warmup_one_step_no_div_zero(self):
+        s = warmup_lr(1.0, 1, warmup_type="log")
+        assert np.isfinite(_f(s(0)))
+        assert _f(s(1)) == pytest.approx(1.0)
 
     def test_zero_warmup_is_constant(self):
         s = warmup_lr(3e-4, 0)

@@ -53,8 +53,8 @@ def warmup_lr(
 ) -> optax.Schedule:
     """DeepSpeed ``WarmupLR``: ramp to ``max_lr`` then hold forever.
 
-    ``warmup_type="log"`` uses DeepSpeed's logarithmic ramp
-    (``log1p(step)/log1p(warmup_steps)``).
+    ``warmup_type="log"`` matches DeepSpeed's logarithmic ramp exactly:
+    ``log(step + 1) / log(warmup_num_steps)``, clipped to 1.
     """
     if warmup_steps < 0:
         raise ValueError(f"warmup_steps must be >= 0, got {warmup_steps}")
@@ -63,7 +63,8 @@ def warmup_lr(
     if warmup_steps == 0:
         return lambda step: jnp.asarray(max_lr, jnp.float32)
 
-    log_denom = math.log1p(warmup_steps)
+    # DeepSpeed clamps warmup_num_steps to >= 2 so log(1) never divides.
+    log_denom = math.log(max(2, warmup_steps))
 
     def schedule(step):
         s = jnp.asarray(step, jnp.float32)
