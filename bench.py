@@ -11,11 +11,15 @@ per-GPU rate the reference's 4xA10G DDP examples would sustain, matching
 the timing hooks at `/root/reference/01_torch_distributor/
 01_basic_torch_distributor.py:376-378`).
 
-Robustness contract (VERDICT r01 #1): the benchmark itself runs in a
-child process; the parent retries transient backend-init failures with
-backoff, then falls back to ``JAX_PLATFORMS=''`` auto-selection and
-finally to CPU, so a degraded run is *labeled* (``backend`` field) rather
-than an rc=1 with no number.
+Robustness contract (VERDICT r01 #1, r02 #1): the benchmark itself runs
+in a child process; the parent is *persistent* about the accelerator —
+spaced preflight retries over a generous window (a wedged remote-compile
+helper can recover), an XLA persistent compile cache so a retry after a
+recovered hang costs seconds instead of a fresh multi-minute compile —
+and only then falls back to ``JAX_PLATFORMS=''`` auto-selection and
+finally to CPU.  Every emitted record carries ``fallback_reason`` and a
+per-attempt ``attempts`` log, so a degraded record is self-explaining
+("TPU down all session" vs "helper down for two minutes").
 
 On TPU: bf16 compute, 224px ImageNet shapes, donated jitted step, MFU
 computed from XLA's compiled-program FLOP count against the chip's peak.
@@ -58,6 +62,17 @@ def _peak_flops(device_kind: str) -> float | None:
 
 def _run_bench() -> None:
     import jax
+
+    # Persistent compiled-program cache: a bench retry after a recovered
+    # backend (or a rerun in the same session) skips recompilation.
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # older jax without the knobs: cache is an optimization only
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -114,6 +129,7 @@ def _run_bench() -> None:
     # forward/image at 224px, x3 for fwd+bwd, divided over chips).
     compiled = step_fn.lower(state, data).compile()
     flops_per_dev_step: float | None = None
+    bytes_per_dev_step: float | None = None
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -121,6 +137,9 @@ def _run_bench() -> None:
         flops = float(ca.get("flops", -1.0)) if ca else -1.0
         if flops > 0:
             flops_per_dev_step = flops
+        nbytes = float(ca.get("bytes accessed", -1.0)) if ca else -1.0
+        if nbytes > 0:
+            bytes_per_dev_step = nbytes
     except Exception:
         pass
     if flops_per_dev_step is None and size == 224:
@@ -170,6 +189,11 @@ def _run_bench() -> None:
                 "chips": chips,
                 "images_per_sec_per_chip": round(value, 2),
                 "mfu": mfu,
+                # per-device HBM traffic from XLA cost analysis (roofline
+                # input for PERF.md); None when the plugin omits it
+                "hbm_gb_per_step": (
+                    round(bytes_per_dev_step / 1e9, 2) if bytes_per_dev_step else None
+                ),
             }
         )
     )
@@ -226,73 +250,146 @@ def main() -> None:
         _run_bench()
         return
 
-    # (extra-env, pre-sleep seconds).  Attempt 2 retries the default
-    # backend after a backoff — r01 died on a transient TPU-init failure.
-    attempts = [
-        ({}, 0.0),
-        ({}, 15.0),
-        ({"JAX_PLATFORMS": ""}, 5.0),  # let jax auto-pick what's available
-        # Guaranteed degraded fallback.  Clearing PALLAS_AXON_POOL_IPS
-        # matters: this image's sitecustomize re-pins the TPU platform
-        # whenever that var is set, overriding JAX_PLATFORMS=cpu — the
-        # CPU rung would otherwise die on the same broken TPU backend.
-        ({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}, 0.0),
-    ]
-    last_err = ""
-    timed_out: set[str] = set()
-    for extra, pre_sleep in attempts:
-        # A timeout is deterministic (backend too slow/hung), not transient:
-        # don't retry an environment whose *effective* backend selection
-        # already timed out (JAX_PLATFORMS='' is the same as unset).
-        effective = {**os.environ, **extra}.get("JAX_PLATFORMS", "")
-        if effective in timed_out:
-            continue
-        if pre_sleep:
-            time.sleep(pre_sleep)
-        env = {**os.environ, **extra, _CHILD_ENV: "1"}
-        # tiny-compile preflight (skipped for the guaranteed-CPU rung):
-        # a wedged accelerator backend hangs compiles instead of erroring,
-        # and must not consume a full bench-child timeout per attempt.
-        if extra.get("JAX_PLATFORMS") != "cpu":
-            verdict, detail = _preflight(env)
-            if verdict != "ok":
-                last_err = f"preflight ({extra or 'default env'}): {detail}"
-                if verdict == "hang":
-                    # deterministic wedge: don't re-burn this backend; a
-                    # fast *failure* stays retryable (attempt 2's backoff
-                    # exists for exactly the transient-init case)
-                    timed_out.add(effective)
-                continue
+    env0 = os.environ
+    t_start = time.monotonic()
+    # Persistence knobs (env-overridable so tests and constrained drivers
+    # can shrink the window).  Defaults: up to 6 accelerator preflights
+    # spaced 150 s apart — a remote-compile helper that recovers within
+    # ~13 minutes still yields a real TPU number.
+    tries = int(env0.get("TPUFRAME_BENCH_PREFLIGHT_TRIES", "6"))
+    hang_spacing = float(env0.get("TPUFRAME_BENCH_PREFLIGHT_SPACING_S", "150"))
+    fail_backoff = float(env0.get("TPUFRAME_BENCH_FAIL_BACKOFF_S", "15"))
+    preflight_timeout = float(env0.get("TPUFRAME_BENCH_PREFLIGHT_TIMEOUT_S", "180"))
+    child_timeout = float(env0.get("TPUFRAME_BENCH_CHILD_TIMEOUT_S", "2400"))
+    deadline = float(env0.get("TPUFRAME_BENCH_DEADLINE_S", "3600"))
+
+    attempts: list[dict] = []
+
+    def note(rung: str, kind: str, verdict: str, detail: str = "") -> None:
+        attempts.append(
+            {
+                "rung": rung,
+                "kind": kind,
+                "verdict": verdict,
+                "detail": detail[-300:] if detail else "",
+                "t_s": round(time.monotonic() - t_start, 1),
+            }
+        )
+
+    def emit(rec: dict, fallback_reason: str | None) -> None:
+        rec["fallback_reason"] = fallback_reason
+        rec["attempts"] = attempts
+        print(json.dumps(rec))
+
+    def budget(reserve: float = 120.0) -> float:
+        """Wall-clock left before ``deadline``, reserving time for the
+        guaranteed CPU rung + emit.  Every subprocess timeout is capped by
+        this so the process NEVER outlives the deadline without having
+        printed a record (a driver killing us at the deadline would
+        otherwise get no JSON at all)."""
+        return max(30.0, deadline - (time.monotonic() - t_start) - reserve)
+
+    def run_child(rung: str, env: dict) -> dict | None:
+        timeout = child_timeout if rung == "cpu" else min(child_timeout, budget())
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
                 env=env,
                 capture_output=True,
                 text=True,
-                timeout=2400,
+                timeout=timeout,
             )
         except subprocess.TimeoutExpired:
-            last_err = "benchmark child timed out"
-            timed_out.add(effective)
-            continue
+            note(rung, "bench", "hang", f"bench child timed out > {timeout:.0f}s")
+            return None
         line = _last_json_line(proc.stdout)
         if proc.returncode == 0 and line:
-            print(line)
-            return
-        last_err = (proc.stderr or proc.stdout or "").strip()[-500:]
+            note(rung, "bench", "ok")
+            return json.loads(line)
+        note(rung, "bench", "fail", (proc.stderr or proc.stdout or "").strip()[-500:])
+        return None
+
+    def child_env(extra: dict) -> dict:
+        env = {**env0, **extra, _CHILD_ENV: "1"}
+        # Persistent XLA compile cache shared across every attempt: a rung
+        # retried after a recovered hang re-uses the compiled program.
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tpuframe_xla_cache")
+        return env
+
+    # --- Rung 1: accelerator (default env), persistent. ---------------
+    # A hang-classified preflight is NOT terminal: the observed failure
+    # mode (remote-compile helper down -> compiles hang forever) can
+    # recover, so keep probing on a spaced schedule within the deadline.
+    accel_env = child_env({})
+    last_accel_err = ""
+    child_runs = 0
+    last_verdict = ""
+    for i in range(tries):
+        if i:
+            # pace off what just happened: a hang earns the long spacing
+            # (give the helper time to recover), a fast failure only a
+            # short backoff
+            time.sleep(hang_spacing if last_verdict == "hang" else fail_backoff)
+        if budget() <= 30.0:
+            note("accel", "preflight", "skip", "bench deadline reached")
+            last_accel_err = last_accel_err or "bench deadline reached"
+            break
+        verdict, detail = _preflight(accel_env, min(preflight_timeout, budget()))
+        note("accel", "preflight", verdict, detail)
+        last_verdict = verdict
+        if verdict == "ok":
+            rec = run_child("accel", accel_env)
+            if rec is not None:
+                emit(rec, None)
+                return
+            child_runs += 1
+            last_verdict = attempts[-1]["verdict"]
+            last_accel_err = attempts[-1]["detail"] or "bench child failed"
+            if child_runs >= 2:
+                break  # two full-bench failures on a healthy-looking backend
+        else:
+            last_accel_err = f"preflight: {detail or verdict}"
+
+    # --- Rung 2: JAX_PLATFORMS='' auto-selection. ----------------------
+    # Only meaningful when the session pinned a platform (the pin itself
+    # may be the problem); with no pin it is the same backend that just
+    # exhausted rung 1.
+    if env0.get("JAX_PLATFORMS") and budget() > 30.0:
+        auto_env = child_env({"JAX_PLATFORMS": ""})
+        verdict, detail = _preflight(auto_env, min(preflight_timeout, budget()))
+        note("auto", "preflight", verdict, detail)
+        if verdict == "ok":
+            rec = run_child("auto", auto_env)
+            if rec is not None:
+                emit(
+                    rec,
+                    f"platform pin {env0['JAX_PLATFORMS']!r} unusable "
+                    f"({last_accel_err}); auto-selected backend",
+                )
+                return
+
+    # --- Rung 3: guaranteed CPU fallback. ------------------------------
+    # Clearing PALLAS_AXON_POOL_IPS matters: this image's sitecustomize
+    # re-pins the TPU platform whenever that var is set, overriding
+    # JAX_PLATFORMS=cpu — the CPU rung would otherwise die on the same
+    # broken TPU backend.
+    cpu_env = child_env({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    rec = run_child("cpu", cpu_env)
+    if rec is not None:
+        emit(rec, f"accelerator unavailable all session: {last_accel_err}")
+        return
 
     # Never exit nonzero: emit a labeled failure record the driver can parse.
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "images/sec/chip (no backend available)",
-                "vs_baseline": 0.0,
-                "backend": "none",
-                "error": last_err,
-            }
-        )
+    emit(
+        {
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec/chip (no backend available)",
+            "vs_baseline": 0.0,
+            "backend": "none",
+            "error": last_accel_err or "no backend available",
+        },
+        "no backend available (accelerator, auto, and cpu rungs all failed)",
     )
 
 

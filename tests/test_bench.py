@@ -1,5 +1,7 @@
-"""bench.py ladder semantics: preflight tri-state, retry preservation,
-wedge poisoning, and the never-rc-1 labeled-failure contract."""
+"""bench.py ladder semantics: persistent accelerator rung (spaced
+preflight retries, hang is NOT terminal), compile-cache env propagation,
+and the self-explaining record contract (fallback_reason + attempts log,
+never rc=1)."""
 
 import importlib.util
 import json
@@ -52,75 +54,114 @@ class _Runner:
         raise AssertionError(outcome)
 
 
-def _run_main(bench, monkeypatch, capsys, script, platform="axon"):
+def _run_main(bench, monkeypatch, capsys, script, platform="axon", tries=2):
     runner = _Runner(script)
     monkeypatch.setattr(bench.subprocess, "run", runner)
-    monkeypatch.setattr(bench.os, "environ", {"JAX_PLATFORMS": platform})
+    monkeypatch.setattr(
+        bench.os,
+        "environ",
+        {
+            "JAX_PLATFORMS": platform,
+            "TPUFRAME_BENCH_PREFLIGHT_TRIES": str(tries),
+        },
+    )
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()[-1]
     return runner, json.loads(out)
 
 
-def test_wedged_backend_poisons_rung_and_falls_to_cpu(
-    bench, monkeypatch, capsys
-):
-    """Preflight hang on attempt 1 skips the backoff retry of the SAME
-    backend and the auto rung, landing on CPU — without burning any full
-    child timeout on the wedged backend."""
+def test_hang_then_recovery_lands_on_accelerator(bench, monkeypatch, capsys):
+    """THE round-2 failure mode: a wedged remote-compile helper that
+    recovers mid-window.  A hang-classified preflight must NOT poison the
+    rung — the next spaced retry succeeds and the accelerator number is
+    captured."""
     runner, rec = _run_main(
         bench,
         monkeypatch,
         capsys,
-        # attempt1 preflight hangs; attempt2 (same backend) skipped;
-        # attempt3 ('' = auto) preflight hangs; attempt4 cpu child runs
-        ["hang", "hang", "ok-child"],
+        ["hang", "ok-preflight", "ok-child"],
     )
     assert [k for k, _ in runner.calls] == ["preflight", "preflight", "child"]
+    assert runner.calls[-1][1] == "axon"
+    assert rec["value"] == 1.0
+    assert rec["fallback_reason"] is None
+    # the hang attempt is still on the record
+    verdicts = [a["verdict"] for a in rec["attempts"]]
+    assert verdicts == ["hang", "ok", "ok"]
+
+
+def test_wedged_all_window_falls_to_cpu_with_reason(bench, monkeypatch, capsys):
+    """Backend wedged the whole window: every accel preflight hangs, the
+    auto rung hangs too, CPU runs — and the record SAYS why."""
+    runner, rec = _run_main(
+        bench,
+        monkeypatch,
+        capsys,
+        # 2 accel preflight hangs, auto-rung preflight hang, cpu child ok
+        ["hang", "hang", "hang", "ok-child"],
+    )
+    kinds = [k for k, _ in runner.calls]
+    assert kinds == ["preflight", "preflight", "preflight", "child"]
+    assert runner.calls[2][1] == ""  # auto rung un-pins the platform
     assert runner.calls[-1][1] == "cpu"
     assert rec["value"] == 1.0
+    assert "accelerator unavailable" in rec["fallback_reason"]
+    assert "preflight" in rec["fallback_reason"]
+    assert [(a["rung"], a["verdict"]) for a in rec["attempts"]] == [
+        ("accel", "hang"),
+        ("accel", "hang"),
+        ("auto", "hang"),
+        ("cpu", "ok"),
+    ]
 
 
 def test_fast_failure_keeps_backoff_retry(bench, monkeypatch, capsys):
     """A transient init *error* (fast, not a hang) must not poison the
-    backend: attempt 2 retries it after backoff — the r01 failure mode."""
+    backend: the next try retries it after a short backoff — the r01
+    failure mode."""
     runner, rec = _run_main(
         bench,
         monkeypatch,
         capsys,
-        # attempt1 preflight fails fast; attempt2 preflight ok, child ok
         ["fail", "ok-preflight", "ok-child"],
     )
     assert [k for k, _ in runner.calls] == ["preflight", "preflight", "child"]
     assert runner.calls[-1][1] == "axon"  # same backend, retried
-    assert rec["value"] == 1.0
+    assert rec["value"] == 1.0 and rec["fallback_reason"] is None
 
 
 def test_total_failure_emits_labeled_record(bench, monkeypatch, capsys):
     """Everything broken -> rc stays 0 and ONE parseable JSON line with
-    backend 'none' and the last real error, never a bare crash."""
+    backend 'none', the last real error, and the full attempts log."""
     runner, rec = _run_main(
         bench,
         monkeypatch,
         capsys,
-        # both accelerator preflights fail fast (incl. retry), cpu child dies
+        # both accel preflights fail fast, auto preflight fails, cpu child dies
         ["fail", "fail", "fail", "fail"],
     )
     kinds = [k for k, _ in runner.calls]
     assert kinds == ["preflight", "preflight", "preflight", "child"]
     assert rec["backend"] == "none" and rec["value"] == 0.0
     assert "error" in rec
+    assert "no backend available" in rec["fallback_reason"]
+    assert [a["rung"] for a in rec["attempts"]] == ["accel", "accel", "auto", "cpu"]
 
 
 def test_cpu_rung_neutralizes_platform_pins(bench, monkeypatch, capsys):
     """The CPU rung must clear the TPU-plugin env pin (sitecustomize
     re-pins the platform off PALLAS_AXON_POOL_IPS) or it dies on the same
     broken backend."""
-    runner = _Runner(["hang", "hang", "ok-child"])
+    runner = _Runner(["hang", "hang", "hang", "ok-child"])
     monkeypatch.setattr(bench.subprocess, "run", runner)
     monkeypatch.setattr(
         bench.os,
         "environ",
-        {"JAX_PLATFORMS": "axon", "PALLAS_AXON_POOL_IPS": "127.0.0.1"},
+        {
+            "JAX_PLATFORMS": "axon",
+            "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+            "TPUFRAME_BENCH_PREFLIGHT_TRIES": "2",
+        },
     )
     bench.main()
     # the final (cpu) call must both select cpu AND clear the plugin pin
@@ -128,3 +169,39 @@ def test_cpu_rung_neutralizes_platform_pins(bench, monkeypatch, capsys):
     assert runner.envs[-1].get("PALLAS_AXON_POOL_IPS") == ""
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] == 1.0
+
+
+def test_compile_cache_env_propagates_to_children(bench, monkeypatch, capsys):
+    """Every child (preflight + bench) gets a persistent XLA compile-cache
+    dir so a rung retried after a recovered hang recompiles nothing."""
+    runner, _rec = _run_main(
+        bench, monkeypatch, capsys, ["ok-preflight", "ok-child"]
+    )
+    assert all(
+        env.get("JAX_COMPILATION_CACHE_DIR") for env in runner.envs
+    ), "compile cache dir missing from a child env"
+
+
+def test_bench_child_failure_retries_then_moves_on(bench, monkeypatch, capsys):
+    """A healthy preflight but repeatedly-dying bench child must not loop
+    the accel rung forever: two full-bench failures end the rung."""
+    runner, rec = _run_main(
+        bench,
+        monkeypatch,
+        capsys,
+        # preflight ok, child dies; retry: preflight ok, child dies;
+        # auto preflight fails; cpu child ok
+        ["ok-preflight", "fail", "ok-preflight", "fail", "fail", "ok-child"],
+        tries=4,
+    )
+    kinds = [k for k, _ in runner.calls]
+    assert kinds == [
+        "preflight",
+        "child",
+        "preflight",
+        "child",
+        "preflight",
+        "child",
+    ]
+    assert runner.calls[-1][1] == "cpu"
+    assert rec["value"] == 1.0 and rec["fallback_reason"]

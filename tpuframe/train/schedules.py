@@ -53,8 +53,12 @@ def warmup_lr(
 ) -> optax.Schedule:
     """DeepSpeed ``WarmupLR``: ramp to ``max_lr`` then hold forever.
 
-    ``warmup_type="log"`` matches DeepSpeed's logarithmic ramp exactly:
-    ``log(step + 1) / log(warmup_num_steps)``, clipped to 1.
+    ``warmup_type="log"`` uses DeepSpeed's logarithmic ramp
+    ``log(step + 1) / log(warmup_steps)`` (denominator clamped to
+    ``log 2``), clipped to 1.  Note DeepSpeed clamps ``warmup_num_steps``
+    itself to >= 2 for *both* ramp types — :func:`from_config` applies
+    that clamp; calling this directly keeps ``warmup_steps=0`` as the
+    "no warmup, constant max_lr" convenience.
     """
     if warmup_steps < 0:
         raise ValueError(f"warmup_steps must be >= 0, got {warmup_steps}")
@@ -182,14 +186,16 @@ def from_config(
     if k in ("warmuplr", "warmup"):
         return warmup_lr(
             max_lr=float(params["warmup_max_lr"]),
-            warmup_steps=int(params.get("warmup_num_steps", 0)),
+            # DeepSpeed's WarmupLR clamps warmup_num_steps to >= 2 for both
+            # ramp types; a config written for it must ramp identically here
+            warmup_steps=max(2, int(params.get("warmup_num_steps", 0))),
             min_lr=float(params.get("warmup_min_lr", 0.0)),
             warmup_type=params.get("warmup_type", "linear"),
         )
     if k == "warmupdecaylr":
         return warmup_decay_lr(
             max_lr=float(params["warmup_max_lr"]),
-            warmup_steps=int(params.get("warmup_num_steps", 0)),
+            warmup_steps=max(2, int(params.get("warmup_num_steps", 0))),
             total_steps=_resolve_auto(
                 params.get("total_num_steps", "auto"), "total_num_steps", total_steps
             ),
