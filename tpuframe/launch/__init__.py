@@ -27,6 +27,11 @@ from tpuframe.launch.distributor import (
     ZeroDistributor,
 )
 from tpuframe.launch.elastic import run_with_restarts
+from tpuframe.launch.remote import (
+    RemoteDistributor,
+    RemoteLaunchError,
+    ssh_connect,
+)
 from tpuframe.launch.trainer_api import (
     Checkpoint,
     Result,
@@ -41,6 +46,9 @@ from tpuframe.launch.trainer_api import (
 __all__ = [
     "Distributor",
     "DistributorError",
+    "RemoteDistributor",
+    "RemoteLaunchError",
+    "ssh_connect",
     "ZeroDistributor",
     "run_with_restarts",
     "Checkpoint",

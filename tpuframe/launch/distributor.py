@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import secrets
 import subprocess
 import sys
 import tempfile
@@ -44,6 +45,56 @@ _KILL_CODES = (-9,)
 #: Once one worker has failed, hung peers get this long to exit on their
 #: own before the driver kills them — not the full run deadline.
 _FAILURE_GRACE_S = 5.0
+
+
+def await_and_root_cause(
+    workers: Sequence[tuple[int, Any, Any]],
+    *,
+    deadline: float,
+    timeout_s: float,
+    make_failure: Callable[[int, int, Any], BaseException],
+    kill_all: Callable[[], None],
+    describe_timeout: Callable[[int], str],
+    self_inflicted: Sequence[int] = _KILL_CODES,
+) -> None:
+    """Shared wait loop for local and remote launchers.
+
+    ``workers`` is ``(rank, popen_like, extra)`` triples in rank order.
+    Waits for every worker under a run-wide ``deadline``; once one has
+    failed, hung peers get only ``_FAILURE_GRACE_S``, not the rest of the
+    deadline.  On timeout, ``kill_all()`` then scan for a *crashed* peer
+    (excluding ``self_inflicted`` codes — our own kill, or a remote
+    agent's orphan-watchdog exit) — the usual distributed-crash
+    shape is one dead rank with everyone else hung at a collective, and
+    the dead rank, not the timeout, is the root cause.  Raises the best
+    failure found, or :class:`TimeoutError`; returns on all-success.
+    """
+    failure: BaseException | None = None
+    timed_out_rank: int | None = None
+    for rank, p, extra in workers:
+        remaining = deadline - time.monotonic()
+        if failure is not None:
+            remaining = min(remaining, _FAILURE_GRACE_S)
+        try:
+            code = p.wait(timeout=max(remaining, 0.1))
+        except subprocess.TimeoutExpired:
+            timed_out_rank = rank
+            break
+        if code != 0 and failure is None:
+            failure = make_failure(rank, code, extra)
+    if timed_out_rank is not None:
+        kill_all()
+        if failure is None:
+            for rank, p, extra in workers:
+                code = p.returncode
+                if code in (None, 0) or code in self_inflicted:
+                    continue
+                failure = make_failure(rank, code, extra)
+                break
+        if failure is None:
+            raise TimeoutError(describe_timeout(timed_out_rank)) from None
+    if failure is not None:
+        raise failure
 
 
 class DistributorError(RuntimeError):
@@ -69,9 +120,17 @@ class Distributor:
       num_processes: worker processes to spawn (hosts on a pod; the
         reference's ``num_processes=NUM_GPUS_PER_NODE``,
         `01_basic_torch_distributor.py:360`).
-      local_mode: run workers on this host (the only mode implemented —
-        remote pod launch goes through your cluster scheduler, which starts
-        one process per host with this same env contract).
+      local_mode: run workers on this host.  ``local_mode=False`` requires
+        ``hosts`` and delegates to :class:`~tpuframe.launch.RemoteDistributor`
+        (one agent per host over the ``connect`` exec transport, ssh by
+        default), matching TorchDistributor's cluster placement
+        (`01_basic_torch_distributor.py:360-367`).
+      hosts: remote host list for ``local_mode=False`` (one rank per host).
+      connect: exec-transport hook for remote mode (see RemoteDistributor).
+      remote_kwargs: extra RemoteDistributor options for remote mode
+        (``master_addr``, ``cp_port``, ``remote_python``, …) — real pods
+        need fixed, host-reachable ports rather than the localhost
+        defaults.
       simulate_devices: per-worker virtual CPU device count (None = inherit
         the real platform).
       env: extra env vars for every worker (the reference forwards
@@ -85,6 +144,9 @@ class Distributor:
         num_processes: int = 1,
         *,
         local_mode: bool = True,
+        hosts: Sequence[str] | None = None,
+        connect: Callable[[str], list] | None = None,
+        remote_kwargs: Mapping[str, Any] | None = None,
         simulate_devices: int | None = None,
         env: Mapping[str, str] | None = None,
         master_port: int = 0,
@@ -92,12 +154,31 @@ class Distributor:
     ):
         if num_processes < 1:
             raise ValueError("num_processes must be >= 1")
+        self._remote = None
         if not local_mode:
-            raise NotImplementedError(
-                "remote launch is the cluster scheduler's job; start one process "
-                "per host with the MASTER_ADDR/RANK/WORLD_SIZE env contract and "
-                "call your train fn directly"
+            from tpuframe.launch.remote import RemoteDistributor
+
+            if not hosts:
+                raise ValueError(
+                    "local_mode=False needs hosts=[...] (one rank per host)"
+                )
+            if num_processes not in (1, len(hosts)):
+                raise ValueError(
+                    f"num_processes ({num_processes}) != len(hosts) "
+                    f"({len(hosts)}); remote mode runs one rank per host"
+                )
+            self._remote = RemoteDistributor(
+                hosts,
+                connect=connect,
+                env=env,
+                master_port=master_port,
+                timeout_s=timeout_s,
+                simulate_devices=simulate_devices,
+                **(remote_kwargs or {}),
             )
+            num_processes = len(hosts)
+        elif remote_kwargs:
+            raise ValueError("remote_kwargs only applies with local_mode=False")
         self.num_processes = num_processes
         self.simulate_devices = simulate_devices
         self.extra_env = dict(env or {})
@@ -127,10 +208,11 @@ class Distributor:
         )
         if self.num_processes > 1:
             env["TPUFRAME_COORDINATOR"] = f"127.0.0.1:{port}"
-            # distinct port + run-scoped token for the host control plane
-            # (run-id broadcast etc.) so two jobs on one host can't cross
+            # distinct port + unguessable run-scoped token for the host
+            # control plane (run-id broadcast etc.) so two jobs on one
+            # host can't cross and strangers can't claim a rank slot
             env["TPUFRAME_CP_PORT"] = str(self._cp_port)
-            env.setdefault("TPUFRAME_CP_TOKEN", f"tpuframe-{port}")
+            env.setdefault("TPUFRAME_CP_TOKEN", self._cp_token)
         if self.simulate_devices:
             env["JAX_PLATFORMS"] = "cpu"
             # An image sitecustomize may force-register a TPU plugin that
@@ -161,8 +243,11 @@ class Distributor:
         """Execute ``fn(*args, **kwargs)`` on every worker; return rank 0's
         result (must be picklable, same constraint as the reference's
         ``return "finished"`` convention, `01_basic_torch_distributor.py:328`)."""
+        if self._remote is not None:
+            return self._remote.run(fn, *args, **kwargs)
         port = self.master_port or self._free_port()
         self._cp_port = self._free_port()
+        self._cp_token = secrets.token_hex(16)
         with tempfile.TemporaryDirectory(prefix="tpuframe_launch_") as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             with open(payload, "wb") as f:
@@ -186,42 +271,19 @@ class Distributor:
                     )
                     procs.append((rank, p, stderr_path))
 
-                failure: BaseException | None = None
-                timed_out_rank: int | None = None
-                for rank, p, stderr_path in procs:
-                    # timeout_s is a run-wide wall-clock cap, so each wait
-                    # gets only what remains of the shared deadline — and
-                    # once a failure is in hand, peers hung at a collective
-                    # get only a short grace, not the rest of the deadline.
-                    remaining = deadline - time.monotonic()
-                    if failure is not None:
-                        remaining = min(remaining, _FAILURE_GRACE_S)
-                    try:
-                        code = p.wait(timeout=max(remaining, 0.1))
-                    except subprocess.TimeoutExpired:
-                        timed_out_rank = rank
-                        break
-                    if code != 0 and failure is None:
-                        failure = self._worker_failure(rank, code, stderr_path, tmp)
-                if timed_out_rank is not None:
-                    self._kill_and_reap(procs)
-                    if failure is None:
-                        # The usual distributed-crash shape: one rank died,
-                        # peers hung at the collective until the deadline.
-                        # The dead rank, not the timeout, is the root cause.
-                        for rank, p, stderr_path in procs:
-                            code = p.returncode
-                            if code in (None, 0) or code in _KILL_CODES:
-                                continue
-                            failure = self._worker_failure(rank, code, stderr_path, tmp)
-                            break
-                    if failure is None:
-                        raise TimeoutError(
-                            f"run exceeded {self.timeout_s}s "
-                            f"(worker rank {timed_out_rank} still running)"
-                        ) from None
-                if failure is not None:
-                    raise failure
+                await_and_root_cause(
+                    procs,
+                    deadline=deadline,
+                    timeout_s=self.timeout_s,
+                    make_failure=lambda rank, code, stderr_path: (
+                        self._worker_failure(rank, code, stderr_path, tmp)
+                    ),
+                    kill_all=lambda: self._kill_and_reap(procs),
+                    describe_timeout=lambda rank: (
+                        f"run exceeded {self.timeout_s}s "
+                        f"(worker rank {rank} still running)"
+                    ),
+                )
             finally:
                 # Every exit path — success, failure, spawn error, ctrl-C —
                 # must leave no live or zombie workers behind (a survivor
