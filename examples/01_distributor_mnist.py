@@ -27,7 +27,7 @@ from _common import base_parser
 from tpuframe import core
 from tpuframe.ckpt import Checkpointer
 from tpuframe.data import DataLoader, SyntheticImageDataset
-from tpuframe.launch import Distributor
+from tpuframe.launch import Distributor, run_with_restarts
 from tpuframe.models import MnistNet
 from tpuframe.parallel import ParallelPlan
 from tpuframe.track import MLflowLogger
@@ -126,7 +126,13 @@ def main(argv=None):
     dist = Distributor(
         num_processes=args.num_processes, simulate_devices=args.simulate_devices
     )
-    result = dist.run(train_mnist, cfg)
+    # Elastic wrapper: a killed/lost rank surfaces within seconds (poll
+    # loop + heartbeat), the run relaunches, and train_mnist resumes from
+    # its Checkpointer instead of recomputing — SURVEY §5 failure
+    # recovery, absent in the reference.
+    result = run_with_restarts(
+        lambda: dist.run(train_mnist, cfg), max_restarts=2
+    )
     print("distributed:", result)
     assert result == "finished"
 

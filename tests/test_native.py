@@ -182,3 +182,46 @@ def test_run_id_broadcast_over_native_plane():
     results = _spawn(_runid_worker, [(r, world, port) for r in range(world)])
     assert all(r[0] == "ok" for r in results), results
     assert {r[2] for r in results} == {"mlflow-run-42"}
+
+
+class TestHeartbeat:
+    def test_beacon_monitor_liveness_and_staleness(self):
+        import time
+
+        from tpuframe.core.native import HeartbeatBeacon, HeartbeatMonitor
+
+        port = _free_port()
+        with HeartbeatMonitor(port, 2, token="hb") as mon:
+            assert mon.ms_since(0) == -1 and mon.ms_since(1) == -1
+            beacon = HeartbeatBeacon(
+                "127.0.0.1", port, 1, token="hb", interval_ms=100
+            )
+            try:
+                deadline = time.monotonic() + 10
+                while mon.ms_since(1) < 0 and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert 0 <= mon.ms_since(1) < 5000
+                assert mon.stale_ranks(1.0) == []
+                # rank 0 never beat: not stale unless explicitly included
+                assert 0 in mon.stale_ranks(5.0, include_unseen=True)
+            finally:
+                beacon.close()
+            # beacon gone: staleness grows past the threshold
+            time.sleep(0.8)
+            assert mon.stale_ranks(0.5) == [1]
+
+    def test_monitor_rejects_bad_token(self):
+        import time
+
+        from tpuframe.core.native import HeartbeatBeacon, HeartbeatMonitor
+
+        port = _free_port()
+        with HeartbeatMonitor(port, 2, token="right") as mon:
+            beacon = HeartbeatBeacon(
+                "127.0.0.1", port, 1, token="wrong", interval_ms=100
+            )
+            try:
+                time.sleep(1.0)
+                assert mon.ms_since(1) == -1  # impostor never registers
+            finally:
+                beacon.close()

@@ -211,3 +211,46 @@ def test_agent_self_terminates_on_driver_disconnect():
 def test_distributor_local_mode_false_requires_hosts():
     with pytest.raises(ValueError, match="hosts"):
         Distributor(local_mode=False)
+
+
+def _rank1_dies_rank0_hangs():
+    import signal
+    import time
+
+    if os.environ["RANK"] == "1":
+        time.sleep(2.0)  # let the beacon be seen first
+        os.kill(os.getpid(), signal.SIGKILL)
+    time.sleep(120)
+
+
+def test_heartbeat_detects_worker_behind_lingering_transport(tmp_path):
+    """The case process-polling can NOT see: the local transport client
+    outlives the remote worker (ssh does exactly this for host-side
+    kills).  The worker's beacon goes silent -> WorkerLostError within
+    seconds, not after the run deadline."""
+    import stat
+    import time
+
+    from tpuframe.launch import WorkerLostError
+
+    # a "transport" that keeps living for a minute after the worker dies
+    wrapper = tmp_path / "lingering_python.sh"
+    wrapper.write_text(
+        f"#!/bin/sh\n{sys.executable} \"$@\"\nrc=$?\nsleep 60\nexit $rc\n"
+    )
+    wrapper.chmod(wrapper.stat().st_mode | stat.S_IEXEC)
+
+    rd = RemoteDistributor(
+        ["hostA", "hostB"],
+        connect=lambda host: list(_LOCAL),
+        remote_python=str(wrapper),
+        master_addr="127.0.0.1",
+        heartbeat_timeout_s=3.0,
+        timeout_s=300.0,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(WorkerLostError) as exc_info:
+        rd.run(_rank1_dies_rank0_hangs)
+    elapsed = time.monotonic() - t0
+    assert exc_info.value.rank == 1
+    assert elapsed < 60, f"detection took {elapsed:.1f}s"

@@ -15,6 +15,7 @@
 // Build: g++ -O2 -shared -fPIC controlplane.cpp -o libtfcp.so -lpthread
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -24,8 +25,14 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <cerrno>
 #include <cstdint>
 #include <cstdlib>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -120,6 +127,24 @@ int64_t now_ms() {
   return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
 }
 
+// Hostname-or-dotted-quad resolver: inet_addr alone rejects DNS names,
+// and launcher host lists are usually names (ssh targets, pod names).
+bool resolve_ipv4(const char* host, in_addr* out) {
+  in_addr_t a = inet_addr(host);
+  if (a != INADDR_NONE) {
+    out->s_addr = a;
+    return true;
+  }
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) return false;
+  *out = ((sockaddr_in*)res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -200,7 +225,10 @@ void* tfcp_spoke_create(const char* hub_addr, int port, int rank, int world,
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons((uint16_t)port);
-    addr.sin_addr.s_addr = inet_addr(hub_addr);
+    if (!resolve_ipv4(hub_addr, &addr.sin_addr)) {
+      close(fd);
+      break;
+    }
     if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
       set_nodelay(fd);
       set_rcvtimeo(fd, timeout_ms * OP_TIMEOUT_FACTOR);
@@ -348,6 +376,207 @@ void tfcp_destroy(void* h) {
     if (fd >= 0) close(fd);
   if (pl->listen_fd >= 0) close(pl->listen_fd);
   delete pl;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Heartbeat: driver-side monitor + worker-side beacon (SURVEY §5: failure
+// detection via missing-host heartbeat).  Deliberately a SEPARATE channel
+// from the collective plane above: collectives are synchronous and
+// sequence-checked, liveness must be asynchronous.  The beacon is a
+// background thread ticking one byte per interval; the monitor records a
+// monotonic last-seen per rank.  What this detects: worker process death,
+// host death, network partition — including the cases where the launcher's
+// local transport client (e.g. an ssh process) is still alive and so
+// process-poll alone says nothing.  What it cannot detect: a wedged main
+// thread (the beacon thread keeps ticking); that stays the run deadline's
+// job.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct HbMonitor {
+  int world = 0;
+  uint64_t token = 0;
+  int listen_fd = -1;
+  std::unique_ptr<std::atomic<int64_t>[]> last_seen;  // now_ms, or -1 never
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::mutex mu;                 // guards conns/readers
+  std::vector<int> conns;
+  std::vector<std::thread> readers;
+};
+
+void hb_reader(HbMonitor* m, int fd, int rank) {
+  // 1-second receive slices so stop is honored promptly
+  set_rcvtimeo(fd, 1000);
+  while (!m->stop.load()) {
+    uint8_t byte;
+    ssize_t r = ::recv(fd, &byte, 1, 0);
+    if (r == 1) {
+      m->last_seen[rank].store(now_ms());
+    } else if (r == 0) {
+      break;  // beacon closed (worker exited)
+    } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      break;
+    }
+  }
+  close(fd);
+}
+
+void hb_acceptor(HbMonitor* m) {
+  while (!m->stop.load()) {
+    pollfd pfd{m->listen_fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, 200);
+    if (pr <= 0) continue;
+    int cfd = accept(m->listen_fd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    set_nodelay(cfd);
+    set_rcvtimeo(cfd, 2000);  // short handshake window for strays
+    uint32_t rank;
+    uint64_t token;
+    if (!recv_all(cfd, &rank, 4) || !recv_all(cfd, &token, 8) ||
+        token != m->token || (int)rank >= m->world) {
+      close(cfd);
+      continue;
+    }
+    m->last_seen[rank].store(now_ms());
+    std::lock_guard<std::mutex> lock(m->mu);
+    if (m->stop.load()) {  // destroy raced the accept
+      close(cfd);
+      return;
+    }
+    m->conns.push_back(cfd);
+    m->readers.emplace_back(hb_reader, m, cfd, (int)rank);
+  }
+}
+
+struct HbBeacon {
+  std::atomic<bool> stop{false};
+  std::thread t;
+};
+
+void hb_beat(HbBeacon* b, std::string addr, int port, int rank, uint64_t token,
+             int interval_ms) {
+  auto nap = [&](int ms) {  // sleep in slices so destroy() is prompt
+    for (int done = 0; done < ms && !b->stop.load(); done += 50)
+      usleep(50 * 1000);
+  };
+  while (!b->stop.load()) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+      nap(500);
+      continue;
+    }
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (!resolve_ipv4(addr.c_str(), &sa.sin_addr)) {
+      close(fd);
+      nap(2000);  // DNS may come up later; keep trying
+      continue;
+    }
+    timeval tv{2, 0};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd, (sockaddr*)&sa, sizeof(sa)) != 0) {
+      close(fd);
+      nap(500);  // monitor not up yet / transient: retry forever
+      continue;
+    }
+    set_nodelay(fd);
+    uint32_t r = (uint32_t)rank;
+    if (!send_all(fd, &r, 4) || !send_all(fd, &token, 8)) {
+      close(fd);
+      nap(500);
+      continue;
+    }
+    while (!b->stop.load()) {
+      uint8_t byte = 1;
+      if (!send_all(fd, &byte, 1)) break;  // reconnect path
+      nap(interval_ms);
+    }
+    close(fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Monitor (driver side): listens for beacon connections, tracks last-seen
+// per rank.  Returns handle or nullptr.
+void* tfhb_monitor_create(const char* bind_addr, int port, int world,
+                          uint64_t token) {
+  HbMonitor* m = new HbMonitor;
+  m->world = world;
+  m->token = token;
+  m->last_seen.reset(new std::atomic<int64_t>[world]);
+  for (int i = 0; i < world; ++i) m->last_seen[i].store(-1);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    delete m;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr =
+      bind_addr && *bind_addr ? inet_addr(bind_addr) : INADDR_ANY;
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, world > 0 ? world : 1) != 0) {
+    close(fd);
+    delete m;
+    return nullptr;
+  }
+  m->listen_fd = fd;
+  m->acceptor = std::thread(hb_acceptor, m);
+  return m;
+}
+
+// Milliseconds since rank's last beacon; -1 if it never connected.
+int64_t tfhb_last_seen_ms(void* h, int rank) {
+  HbMonitor* m = (HbMonitor*)h;
+  if (rank < 0 || rank >= m->world) return -1;
+  int64_t t = m->last_seen[rank].load();
+  if (t < 0) return -1;
+  int64_t d = now_ms() - t;
+  return d < 0 ? 0 : d;
+}
+
+void tfhb_monitor_destroy(void* h) {
+  HbMonitor* m = (HbMonitor*)h;
+  if (!m) return;
+  m->stop.store(true);
+  if (m->acceptor.joinable()) m->acceptor.join();
+  {
+    std::lock_guard<std::mutex> lock(m->mu);
+    for (int fd : m->conns) shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : m->readers)
+    if (t.joinable()) t.join();
+  if (m->listen_fd >= 0) close(m->listen_fd);
+  delete m;
+}
+
+// Beacon (worker side): background thread, connects (retrying forever —
+// the monitor may start later or restart) and ticks every interval_ms.
+void* tfhb_beacon_create(const char* addr, int port, int rank, uint64_t token,
+                         int interval_ms) {
+  HbBeacon* b = new HbBeacon;
+  b->t = std::thread(hb_beat, b, std::string(addr ? addr : "127.0.0.1"), port,
+                     rank, token, interval_ms > 0 ? interval_ms : 1000);
+  return b;
+}
+
+void tfhb_beacon_destroy(void* h) {
+  HbBeacon* b = (HbBeacon*)h;
+  if (!b) return;
+  b->stop.store(true);
+  if (b->t.joinable()) b->t.join();
+  delete b;
 }
 
 }  // extern "C"

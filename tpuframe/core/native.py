@@ -176,13 +176,7 @@ class ControlPlane:
             token = os.environ.get("TPUFRAME_CP_TOKEN", "")
         # shared-token handshake: strangers that don't know the token can't
         # claim a rank slot (ADVICE r01); empty token -> 0, c10d-style trust
-        token_u64 = (
-            int.from_bytes(
-                hashlib.sha256(token.encode()).digest()[:8], "little"
-            )
-            if token
-            else 0
-        )
+        token_u64 = _token_u64(token)
         self.rank, self.world = rank, world
         self._h = None
         self._lib = None
@@ -291,6 +285,132 @@ class ControlPlane:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def _hb_lib():
+    """The heartbeat entry points live in the control-plane library."""
+    lib = _build_and_load("tfcp", "controlplane.cpp", [])
+    if lib is not None and not getattr(lib, "_hb_sigs", False):
+        lib.tfhb_monitor_create.restype = ctypes.c_void_p
+        lib.tfhb_monitor_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+        lib.tfhb_last_seen_ms.restype = ctypes.c_int64
+        lib.tfhb_last_seen_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tfhb_monitor_destroy.argtypes = [ctypes.c_void_p]
+        lib.tfhb_beacon_create.restype = ctypes.c_void_p
+        lib.tfhb_beacon_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int]
+        lib.tfhb_beacon_destroy.argtypes = [ctypes.c_void_p]
+        lib._hb_sigs = True
+    return lib
+
+
+def _token_u64(token: str) -> int:
+    return (
+        int.from_bytes(hashlib.sha256(token.encode()).digest()[:8], "little")
+        if token
+        else 0
+    )
+
+
+class HeartbeatMonitor:
+    """Driver-side liveness tracker (SURVEY §5 missing-host heartbeat).
+
+    Workers run a :class:`HeartbeatBeacon`; the monitor answers "how long
+    since rank k last beat?".  Detects worker/host/network death even when
+    the launcher's local transport process (e.g. an ssh client) is still
+    alive.  A wedged-but-alive main thread is NOT detected — the beacon
+    ticks from a background thread; that case stays with the run deadline.
+    """
+
+    def __init__(self, port: int, world: int, *, token: str = "",
+                 bind: str = ""):
+        lib = _hb_lib()
+        if lib is None:
+            raise RuntimeError("heartbeat needs g++ (no toolchain found)")
+        self._lib = lib
+        self.world = world
+        self._h = lib.tfhb_monitor_create(
+            bind.encode(), port, world, _token_u64(token)
+        )
+        if not self._h:
+            raise OSError(f"heartbeat monitor failed to bind port {port}")
+
+    def ms_since(self, rank: int) -> int:
+        """Milliseconds since ``rank``'s last beat; -1 if never seen."""
+        return int(self._lib.tfhb_last_seen_ms(self._h, rank))
+
+    def stale_ranks(self, timeout_s: float, *, include_unseen: bool = False
+                    ) -> list[int]:
+        """Ranks whose last beat is older than ``timeout_s`` (unseen ranks
+        only when ``include_unseen`` — startup takes a while)."""
+        out = []
+        for r in range(self.world):
+            ms = self.ms_since(r)
+            if ms < 0:
+                if include_unseen:
+                    out.append(r)
+            elif ms > timeout_s * 1000:
+                out.append(r)
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tfhb_monitor_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class HeartbeatBeacon:
+    """Worker-side beat: a background thread ticking one byte per interval
+    at the monitor, reconnecting forever on failure.  Start it early (the
+    launcher's worker shims do) and forget it."""
+
+    def __init__(self, address: str, port: int, rank: int, *,
+                 token: str = "", interval_ms: int = 1000):
+        lib = _hb_lib()
+        if lib is None:
+            raise RuntimeError("heartbeat needs g++ (no toolchain found)")
+        self._lib = lib
+        self._h = lib.tfhb_beacon_create(
+            address.encode(), port, rank, _token_u64(token), interval_ms
+        )
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tfhb_beacon_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def maybe_start_beacon() -> HeartbeatBeacon | None:
+    """Start a beacon from the launcher env contract, if one is requested
+    (``TPUFRAME_HB_PORT`` set).  Called by the worker/agent shims before
+    the user fn runs; returns None when heartbeating is off."""
+    port = os.environ.get("TPUFRAME_HB_PORT")
+    if not port:
+        return None
+    try:
+        return HeartbeatBeacon(
+            os.environ.get("TPUFRAME_HB_ADDR")
+            or os.environ.get("MASTER_ADDR", "127.0.0.1"),
+            int(port),
+            int(os.environ.get("RANK", "0")),
+            token=os.environ.get("TPUFRAME_CP_TOKEN", ""),
+        )
+    except Exception:
+        return None  # liveness is best-effort; never block training on it
 
 
 _CONTROL_PLANE: ControlPlane | None = None

@@ -24,6 +24,7 @@ TPU-native replacement for the reference's L5 launchers (SURVEY.md §1):
 from tpuframe.launch.distributor import (
     Distributor,
     DistributorError,
+    WorkerLostError,
     ZeroDistributor,
 )
 from tpuframe.launch.elastic import run_with_restarts
@@ -49,6 +50,7 @@ __all__ = [
     "RemoteDistributor",
     "RemoteLaunchError",
     "ssh_connect",
+    "WorkerLostError",
     "ZeroDistributor",
     "run_with_restarts",
     "Checkpoint",

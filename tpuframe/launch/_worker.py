@@ -15,6 +15,12 @@ import sys
 def main(payload_path: str, result_path: str) -> None:
     import cloudpickle
 
+    # liveness beacon (before anything heavy: the driver should see this
+    # rank alive while jax imports grind)
+    from tpuframe.core.native import maybe_start_beacon
+
+    maybe_start_beacon()
+
     with open(payload_path, "rb") as f:
         fn, args, kwargs = cloudpickle.load(f)
     try:

@@ -107,6 +107,11 @@ def main() -> None:
         raise SystemExit(1)
     _arm_orphan_watchdog()
 
+    if env.get("TPUFRAME_HB_PORT"):
+        from tpuframe.core.native import maybe_start_beacon
+
+        maybe_start_beacon()
+
     if env.get("TPUFRAME_SIMULATE_DEVICES"):
         # virtual CPU mesh for pod-topology tests; must beat any real
         # backend init AND undo an image sitecustomize's platform pin,

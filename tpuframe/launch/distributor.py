@@ -47,6 +47,33 @@ _KILL_CODES = (-9,)
 _FAILURE_GRACE_S = 5.0
 
 
+def _free_port() -> int:
+    """A port currently bindable on all interfaces (rendezvous hubs and
+    heartbeat monitors bind INADDR_ANY)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _stale_rank_check(monitor, timeout_s):
+    """health_check closure over a HeartbeatMonitor (None when disabled):
+    the first still-pending rank whose beacon went silent becomes a
+    WorkerLostError.  Only pending ranks count — a cleanly-exited
+    worker's beacon goes silent too, and must not fail the run."""
+    if monitor is None or not timeout_s:
+        return None
+
+    def check(pending_ranks):
+        for r in monitor.stale_ranks(timeout_s):
+            if r in pending_ranks:
+                return WorkerLostError(r, monitor.ms_since(r) / 1000.0)
+        return None
+
+    return check
+
+
 def await_and_root_cause(
     workers: Sequence[tuple[int, Any, Any]],
     *,
@@ -56,33 +83,52 @@ def await_and_root_cause(
     kill_all: Callable[[], None],
     describe_timeout: Callable[[int], str],
     self_inflicted: Sequence[int] = _KILL_CODES,
+    health_check: Callable[[set], BaseException | None] | None = None,
+    poll_interval_s: float = 0.2,
 ) -> None:
     """Shared wait loop for local and remote launchers.
 
     ``workers`` is ``(rank, popen_like, extra)`` triples in rank order.
-    Waits for every worker under a run-wide ``deadline``; once one has
-    failed, hung peers get only ``_FAILURE_GRACE_S``, not the rest of the
-    deadline.  On timeout, ``kill_all()`` then scan for a *crashed* peer
-    (excluding ``self_inflicted`` codes — our own kill, or a remote
-    agent's orphan-watchdog exit) — the usual distributed-crash
-    shape is one dead rank with everyone else hung at a collective, and
-    the dead rank, not the timeout, is the root cause.  Raises the best
-    failure found, or :class:`TimeoutError`; returns on all-success.
+    Polls ALL workers (a dead rank is noticed within ``poll_interval_s``
+    no matter its rank, not after its predecessors exit) under a run-wide
+    ``deadline``; once one has failed, hung peers get only
+    ``_FAILURE_GRACE_S``, not the rest of the deadline.  ``health_check``
+    (heartbeat staleness, typically) receives the set of still-pending
+    ranks and may return an exception to declare one lost.  On deadline,
+    ``kill_all()`` then
+    scan for a *crashed* peer (excluding ``self_inflicted`` codes — our
+    own kill, or a remote agent's orphan-watchdog exit) — the usual
+    distributed-crash shape is one dead rank with everyone else hung at a
+    collective, and the dead rank, not the timeout, is the root cause.
+    Raises the best failure found, or :class:`TimeoutError`; returns on
+    all-success.
     """
+    pending: dict[int, tuple[Any, Any]] = {r: (p, e) for r, p, e in workers}
     failure: BaseException | None = None
-    timed_out_rank: int | None = None
-    for rank, p, extra in workers:
-        remaining = deadline - time.monotonic()
-        if failure is not None:
-            remaining = min(remaining, _FAILURE_GRACE_S)
-        try:
-            code = p.wait(timeout=max(remaining, 0.1))
-        except subprocess.TimeoutExpired:
-            timed_out_rank = rank
+    grace_deadline: float | None = None
+    while pending:
+        now = time.monotonic()
+        cap = deadline if grace_deadline is None else min(deadline, grace_deadline)
+        if now >= cap:
             break
-        if code != 0 and failure is None:
-            failure = make_failure(rank, code, extra)
-    if timed_out_rank is not None:
+        for rank in list(pending):
+            p, extra = pending[rank]
+            code = p.poll()
+            if code is None:
+                continue
+            del pending[rank]
+            if code != 0 and failure is None:
+                failure = make_failure(rank, code, extra)
+                grace_deadline = time.monotonic() + _FAILURE_GRACE_S
+        if pending and failure is None and health_check is not None:
+            lost = health_check(set(pending))
+            if lost is not None:
+                # the lost worker stays in pending: kill_all reaps it
+                failure = lost
+                grace_deadline = time.monotonic() + _FAILURE_GRACE_S
+        if pending:
+            time.sleep(min(poll_interval_s, max(cap - time.monotonic(), 0.0)))
+    if pending:
         kill_all()
         if failure is None:
             for rank, p, extra in workers:
@@ -92,7 +138,7 @@ def await_and_root_cause(
                 failure = make_failure(rank, code, extra)
                 break
         if failure is None:
-            raise TimeoutError(describe_timeout(timed_out_rank)) from None
+            raise TimeoutError(describe_timeout(next(iter(pending)))) from None
     if failure is not None:
         raise failure
 
@@ -111,6 +157,23 @@ class DistributorError(RuntimeError):
             f"worker rank {rank} exited with code {returncode}\n"
             f"--- stderr tail ---\n{stderr_tail}"
         )
+
+
+class WorkerLostError(DistributorError):
+    """A worker's liveness beacon went silent while its launch-side
+    process handle still looked alive — host death, network partition, or
+    a kill that the local transport client (ssh) couldn't surface."""
+
+    def __init__(self, rank: int, silent_s: float):
+        RuntimeError.__init__(
+            self,
+            f"worker rank {rank} lost: no heartbeat for {silent_s:.1f}s "
+            "(process dead on its host, host down, or partitioned)",
+        )
+        self.rank = rank
+        self.returncode = None
+        self.stderr_tail = ""
+        self.silent_s = silent_s
 
 
 class Distributor:
@@ -137,6 +200,11 @@ class Distributor:
         ``DATABRICKS_HOST``/``TOKEN`` this way, `setup/00_setup.py:86-92`).
       master_port: rendezvous port (0 = pick a free one).
       timeout_s: per-run wall-clock cap.
+      heartbeat_timeout_s: declare a rank lost (WorkerLostError, within
+        seconds — not after burning ``timeout_s``) when its liveness
+        beacon goes silent this long after having been seen.  None
+        disables.  Detects process/host/network death; a wedged-but-alive
+        worker still rides the run deadline.
     """
 
     def __init__(
@@ -151,6 +219,7 @@ class Distributor:
         env: Mapping[str, str] | None = None,
         master_port: int = 0,
         timeout_s: float = 600.0,
+        heartbeat_timeout_s: float | None = 15.0,
     ):
         if num_processes < 1:
             raise ValueError("num_processes must be >= 1")
@@ -167,15 +236,16 @@ class Distributor:
                     f"num_processes ({num_processes}) != len(hosts) "
                     f"({len(hosts)}); remote mode runs one rank per host"
                 )
-            self._remote = RemoteDistributor(
-                hosts,
+            rk: dict[str, Any] = dict(
                 connect=connect,
                 env=env,
                 master_port=master_port,
                 timeout_s=timeout_s,
                 simulate_devices=simulate_devices,
-                **(remote_kwargs or {}),
+                heartbeat_timeout_s=heartbeat_timeout_s,
             )
+            rk.update(remote_kwargs or {})  # explicit overrides win
+            self._remote = RemoteDistributor(hosts, **rk)
             num_processes = len(hosts)
         elif remote_kwargs:
             raise ValueError("remote_kwargs only applies with local_mode=False")
@@ -184,6 +254,8 @@ class Distributor:
         self.extra_env = dict(env or {})
         self.master_port = master_port
         self.timeout_s = timeout_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._hb_port: int | None = None
 
     # -- env -----------------------------------------------------------------
     def _worker_env(self, rank: int, port: int) -> dict[str, str]:
@@ -212,7 +284,13 @@ class Distributor:
             # control plane (run-id broadcast etc.) so two jobs on one
             # host can't cross and strangers can't claim a rank slot
             env["TPUFRAME_CP_PORT"] = str(self._cp_port)
-            env.setdefault("TPUFRAME_CP_TOKEN", self._cp_token)
+            # plain assignment, not setdefault: the heartbeat monitor was
+            # built with _cp_token, and an inherited env token would make
+            # every beacon look like an impostor
+            env["TPUFRAME_CP_TOKEN"] = self._cp_token
+        if self._hb_port:
+            env["TPUFRAME_HB_PORT"] = str(self._hb_port)
+            env["TPUFRAME_HB_ADDR"] = "127.0.0.1"
         if self.simulate_devices:
             env["JAX_PLATFORMS"] = "cpu"
             # An image sitecustomize may force-register a TPU plugin that
@@ -230,13 +308,7 @@ class Distributor:
             ).strip()
         return env
 
-    @staticmethod
-    def _free_port() -> int:
-        import socket
-
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
+    _free_port = staticmethod(_free_port)
 
     # -- run -----------------------------------------------------------------
     def run(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
@@ -247,7 +319,24 @@ class Distributor:
             return self._remote.run(fn, *args, **kwargs)
         port = self.master_port or self._free_port()
         self._cp_port = self._free_port()
-        self._cp_token = secrets.token_hex(16)
+        # honor a caller-provided token (env= or ambient) so external
+        # tooling that knows it can still join; otherwise unguessable
+        self._cp_token = (
+            self.extra_env.get("TPUFRAME_CP_TOKEN")
+            or os.environ.get("TPUFRAME_CP_TOKEN")
+            or secrets.token_hex(16)
+        )
+        monitor = None
+        if self.heartbeat_timeout_s and self.num_processes > 1:
+            try:
+                from tpuframe.core.native import HeartbeatMonitor
+
+                self._hb_port = self._free_port()
+                monitor = HeartbeatMonitor(
+                    self._hb_port, self.num_processes, token=self._cp_token
+                )
+            except Exception:
+                monitor, self._hb_port = None, None  # liveness is best-effort
         with tempfile.TemporaryDirectory(prefix="tpuframe_launch_") as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             with open(payload, "wb") as f:
@@ -283,6 +372,9 @@ class Distributor:
                         f"run exceeded {self.timeout_s}s "
                         f"(worker rank {rank} still running)"
                     ),
+                    health_check=_stale_rank_check(
+                        monitor, self.heartbeat_timeout_s
+                    ),
                 )
             finally:
                 # Every exit path — success, failure, spawn error, ctrl-C —
@@ -292,6 +384,9 @@ class Distributor:
                 self._kill_and_reap(procs)
                 for f in stderr_files:
                     f.close()
+                if monitor is not None:
+                    monitor.close()
+                self._hb_port = None
 
             with open(os.path.join(tmp, "result_0.pkl"), "rb") as f:
                 outcome = pickle.load(f)

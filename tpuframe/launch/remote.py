@@ -45,6 +45,8 @@ from tpuframe.launch.distributor import (
     _KILL_CODES,
     _STDERR_TAIL,
     DistributorError,
+    _free_port,
+    _stale_rank_check,
     await_and_root_cause,
 )
 
@@ -187,6 +189,9 @@ class RemoteDistributor:
         simulate_devices: int | None = None,
         stream_output: bool = False,
         timeout_s: float = 600.0,
+        heartbeat_timeout_s: float | None = 15.0,
+        driver_addr: str | None = None,
+        hb_port: int = 0,
     ):
         if not hosts:
             raise ValueError("hosts must be non-empty")
@@ -204,10 +209,16 @@ class RemoteDistributor:
         self.simulate_devices = simulate_devices
         self.stream_output = stream_output
         self.timeout_s = timeout_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        # beacons target the DRIVER (which may be neither of the hosts);
+        # default works for localhost testing — real pods pass the
+        # driver's host-reachable address + a fixed, unfirewalled hb_port
+        self.driver_addr = driver_addr
+        self.hb_port = hb_port
 
     # -- env -----------------------------------------------------------------
     def _worker_env(self, rank: int, master: str, port: int, cp_port: int,
-                    token: str) -> dict[str, str]:
+                    token: str, hb_port: int | None) -> dict[str, str]:
         world = len(self.hosts)
         env = dict(self.extra_env)
         env.update(
@@ -222,12 +233,16 @@ class RemoteDistributor:
         if world > 1:
             env["TPUFRAME_COORDINATOR"] = f"{master}:{port}"
             env["TPUFRAME_CP_PORT"] = str(cp_port)
-            env.setdefault("TPUFRAME_CP_TOKEN", token)
+            # plain assignment: monitor/hub were built with this token
+            env["TPUFRAME_CP_TOKEN"] = token
         if self.simulate_devices:
             # the agent resolves this into a virtual CPU platform before
             # the payload runs (env + live jax config, beating any image
             # sitecustomize platform pin)
             env["TPUFRAME_SIMULATE_DEVICES"] = str(self.simulate_devices)
+        if hb_port:
+            env["TPUFRAME_HB_PORT"] = str(hb_port)
+            env["TPUFRAME_HB_ADDR"] = self.driver_addr or master
         ship = self.ship_pythonpath
         if ship is None:
             ship = not self.shell_quote
@@ -236,13 +251,7 @@ class RemoteDistributor:
             env["PYTHONPATH"] = os.pathsep.join(path)
         return env
 
-    @staticmethod
-    def _free_port() -> int:
-        import socket
-
-        with socket.socket() as s:
-            s.bind(("0.0.0.0", 0))
-            return s.getsockname()[1]
+    _free_port = staticmethod(_free_port)
 
     def _command(self, host: str) -> list[str]:
         prefix = list(self.connect(host))
@@ -266,8 +275,19 @@ class RemoteDistributor:
         # unguessable run-scoped control-plane token: the hub is reachable
         # on the pod network, and the token ships out-of-band (stdin
         # header), so strangers who can reach the port still can't join
-        token = secrets.token_hex(16)
+        token = self.extra_env.get("TPUFRAME_CP_TOKEN") or secrets.token_hex(16)
         payload = cloudpickle.dumps((fn, args, kwargs))
+
+        monitor = None
+        hb_port: int | None = None
+        if self.heartbeat_timeout_s and world > 1:
+            try:
+                from tpuframe.core.native import HeartbeatMonitor
+
+                hb_port = self.hb_port or self._free_port()
+                monitor = HeartbeatMonitor(hb_port, world, token=token)
+            except Exception:
+                monitor, hb_port = None, None  # liveness is best-effort
 
         workers: list[_Worker] = []
         deadline = time.monotonic() + self.timeout_s
@@ -278,7 +298,7 @@ class RemoteDistributor:
                         {
                             "payload_bytes": len(payload),
                             "env": self._worker_env(
-                                rank, master, port, cp_port, token
+                                rank, master, port, cp_port, token, hb_port
                             ),
                         }
                     ).encode()
@@ -319,13 +339,23 @@ class RemoteDistributor:
                 # its orphan watchdog before our kill lands — that's
                 # self-inflicted, not a root cause
                 self_inflicted=(*_KILL_CODES, ORPHANED_EXIT),
+                health_check=_stale_rank_check(
+                    monitor, self.heartbeat_timeout_s
+                ),
             )
         finally:
             self._kill_and_reap(workers)
             for w in workers:
                 w.join_pumps()
+            if monitor is not None:
+                monitor.close()
 
         w0 = workers[0]
+        if w0.outcome is None and w0.frame_error is None:
+            # a big result frame (base64 of hundreds of MB) can still be
+            # draining through the pump after process exit — give it real
+            # time before declaring the frame missing
+            w0.join_pumps(timeout=60.0)
         if w0.outcome is None:
             raise RemoteLaunchError(
                 w0.host,
