@@ -14,6 +14,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # acceptance tier: replays/convergence, minutes not seconds
+
 from tpuframe.core import MeshSpec
 from tpuframe.core import runtime as rt
 from tpuframe.data import DataLoader, SyntheticImageDataset

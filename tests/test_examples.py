@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # acceptance tier: replays/convergence, minutes not seconds
+
 EXAMPLES = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
 
 SMOKE = [

@@ -319,6 +319,7 @@ def _trainer_invariance_worker(cfg):
     return result.metrics
 
 
+@pytest.mark.slow
 def test_trainer_metrics_process_count_invariant():
     """VERDICT r01 #6: loss/accuracy and the samples/sec *accounting* must
     not depend on how many processes share the same global batch."""
@@ -362,6 +363,7 @@ def test_result_history_tolerates_truncated_line(tmp_path):
     assert second.metrics == {"x": 1.0}
 
 
+@pytest.mark.slow
 def test_elastic_restart_resumes_training_from_checkpoint(tmp_path):
     """Integrated preemption story: fit crashes mid-run, run_with_restarts
     re-launches it, and the fresh Trainer resumes from the checkpoint
@@ -429,6 +431,7 @@ def _rank1_sigkill_rank0_hangs():
     time.sleep(60)
 
 
+@pytest.mark.slow
 def test_killed_rank_detected_fast():
     """VERDICT r02 #6: a killed rank must surface within seconds — the
     poll-all wait loop notices any dead rank immediately instead of
@@ -459,6 +462,7 @@ def _die_once_then_finish(flag_path):
     return f"done-{os.environ['RANK']}"
 
 
+@pytest.mark.slow
 def test_restart_loop_recovers_from_killed_rank(tmp_path):
     """The integrated failure-recovery story: fast kill detection feeds
     run_with_restarts, which relaunches the whole Distributor run."""

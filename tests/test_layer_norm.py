@@ -165,6 +165,7 @@ class TestFusedLayerNormModule:
         ref = nn.LayerNorm().init(jax.random.PRNGKey(0), x)
         assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(ref)
 
+    @pytest.mark.slow
     def test_transformer_checkpoint_compatible(self):
         """TransformerLM params trained before the swap load unchanged:
         the module keeps nn.LayerNorm's param names inside ln1/ln2/ln_f."""

@@ -16,6 +16,7 @@ def n_params(tree):
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
+@pytest.mark.slow
 def test_resnet18_cifar_shapes_and_param_count(rng):
     model = ResNet18(num_classes=10, stem="cifar")
     x = jnp.zeros((2, 32, 32, 3))
@@ -26,6 +27,7 @@ def test_resnet18_cifar_shapes_and_param_count(rng):
     assert 10.5e6 < n_params(variables["params"]) < 11.5e6
 
 
+@pytest.mark.slow
 def test_resnet50_imagenet_shapes_and_param_count(rng):
     model = ResNet50(num_classes=1000)
     x = jnp.zeros((1, 64, 64, 3))
@@ -153,6 +155,7 @@ def test_torch_resnet_import_round_trip(rng):
     assert out.shape == (1, 10)
 
 
+@pytest.mark.slow
 class TestViT:
     def test_vit_s16_shapes_and_param_count(self, rng):
         from tpuframe.models import ViT_S16

@@ -153,6 +153,7 @@ def test_aux_loss_reaches_training_objective():
 
 
 class TestMoETransformer:
+    @pytest.mark.slow
     def test_moe_lm_trains_with_expert_parallelism(self):
         """GShard-style MoE transformer: MoE MLP in every block, expert
         weights sharded over the expert axis, router aux loss folded into

@@ -86,6 +86,7 @@ class TestReplicaGroupedBatchNorm:
             self._apply(np.ones((7, 2, 2, 3), np.float32), groups=2)
 
 
+@pytest.mark.slow
 class TestResNetBnStats:
     def test_local_resnet_runs_and_differs_from_sync(self):
         x = np.random.default_rng(0).standard_normal((8, 16, 16, 3)).astype(np.float32)

@@ -225,6 +225,7 @@ class TestPrecision:
         assert tr.policy.param_dtype == jnp.float32
 
 
+@pytest.mark.slow
 class TestHostOffload:
     def _shapes(self):
         import jax.numpy as jnp

@@ -43,6 +43,7 @@ def _sequential(stacked, x):
     return jax.vmap(apply_mb)(x)
 
 
+@pytest.mark.slow
 class TestGpipeSchedule:
     def test_forward_matches_sequential(self):
         mesh = MeshSpec(pipe=4, data=2).build()
@@ -91,6 +92,7 @@ class TestGpipeSchedule:
         )
 
 
+@pytest.mark.slow
 class TestPipelinedLM:
     @pytest.fixture(autouse=True)
     def pipe_runtime(self):

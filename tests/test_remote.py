@@ -105,6 +105,7 @@ def _crash_or_hang():
     time.sleep(60)
 
 
+@pytest.mark.slow
 def test_remote_timeout_surfaces_crashed_peer():
     with pytest.raises(RuntimeError, match="root cause on hostA"):
         _two_hosts(timeout_s=15.0).run(_crash_or_hang)
@@ -155,6 +156,7 @@ def _device_count():
     return jax.device_count()
 
 
+@pytest.mark.slow
 def test_remote_simulate_devices():
     """Pod-topology simulation crosses the launch boundary: each agent
     resolves TPUFRAME_SIMULATE_DEVICES into a virtual CPU platform before
@@ -223,6 +225,7 @@ def _rank1_dies_rank0_hangs():
     time.sleep(120)
 
 
+@pytest.mark.slow
 def test_heartbeat_detects_worker_behind_lingering_transport(tmp_path):
     """The case process-polling can NOT see: the local transport client
     outlives the remote worker (ssh does exactly this for host-side

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # acceptance tier: replays/convergence, minutes not seconds
+
 from tpuframe.core import MeshSpec
 from tpuframe.ops.ring_attention import attention_reference, ring_attention
 

@@ -187,6 +187,7 @@ class TestFromConfig:
         assert _f(s(100)) == pytest.approx(2e-4)
 
 
+@pytest.mark.slow
 class TestTrainerWiring:
     def test_trainer_accepts_scheduler_dict(self):
         """lr= takes the DeepSpeed scheduler dict; total 'auto' resolves

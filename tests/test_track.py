@@ -147,6 +147,7 @@ def test_trace_context_manager_captures(tmp_path):
     assert found, f"no xplane captured under {logdir}"
 
 
+@pytest.mark.slow
 def test_profiler_callback_in_trainer(tmp_path):
     from tpuframe.data import DataLoader, SyntheticImageDataset
     from tpuframe.models import MnistNet
@@ -179,6 +180,7 @@ def test_profiler_callback_in_trainer(tmp_path):
     assert s["step_time_p95_s"] >= s["step_time_p50_s"] >= 0
 
 
+@pytest.mark.slow
 def test_profiler_callback_closes_trace_on_early_end(tmp_path):
     # duration reached mid-capture: on_fit_end must stop the profiler so a
     # following fit can start its own trace.

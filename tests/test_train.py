@@ -206,6 +206,7 @@ class TestTrainerLoop:
         assert len(result.history) == 1
         assert trainer.batches_seen == 4  # 64 / 16
 
+    @pytest.mark.slow
     def test_duration_in_batches(self):
         lt, _ = self._loaders()
         trainer = Trainer(
@@ -217,6 +218,7 @@ class TestTrainerLoop:
         trainer.fit()
         assert trainer.batches_seen == 2
 
+    @pytest.mark.slow
     def test_loss_falls_over_epochs(self):
         lt, _ = self._loaders(n=128)
         trainer = Trainer(
@@ -230,6 +232,7 @@ class TestTrainerLoop:
         result = trainer.fit()
         assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
 
+    @pytest.mark.slow
     def test_algorithms_in_loop(self):
         lt, le = self._loaders()
         trainer = Trainer(
@@ -243,6 +246,7 @@ class TestTrainerLoop:
         result = trainer.fit()
         assert np.isfinite(result.metrics["train_loss"])
 
+    @pytest.mark.slow
     def test_early_stopping(self):
         lt, le = self._loaders()
         stopper = EarlyStopping(monitor="eval_loss", patience=1)
@@ -314,6 +318,7 @@ class TestTrainerLoop:
         with pytest.raises(ValueError, match="not divisible"):
             trainer.fit()
 
+    @pytest.mark.slow
     def test_logger_receives_metrics(self):
         class Capture:
             def __init__(self):
@@ -337,6 +342,7 @@ class TestTrainerLoop:
         ).fit()
         assert cap.params and cap.metrics
 
+    @pytest.mark.slow
     def test_predict_spot_check(self):
         lt, _ = self._loaders()
         trainer = Trainer(
@@ -349,6 +355,7 @@ class TestTrainerLoop:
         assert logits.shape == (1, 4)
 
 
+@pytest.mark.slow
 class TestTrainerSharded:
     def test_zero3_resnet_epoch(self):
         """Full Trainer epoch with ZeRO-3 params over a dp2 x fsdp4 mesh."""

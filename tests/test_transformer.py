@@ -27,6 +27,7 @@ def _tokens(b=4, l=32, vocab=64, seed=0):
     return jnp.asarray(rng.integers(0, vocab, (b, l)).astype(np.int32))
 
 
+@pytest.mark.slow
 def test_full_vs_ring_forward_match(seq_runtime):
     tokens = _tokens()
     model_kw = dict(vocab_size=64, num_layers=2, num_heads=4, head_dim=8, max_len=64)
@@ -41,6 +42,7 @@ def test_full_vs_ring_forward_match(seq_runtime):
     )
 
 
+@pytest.mark.slow
 def test_auto_dispatch_uses_ring_when_seq_sharded(seq_runtime):
     # auto == ring on this mesh (seq axis size 2): outputs must match full
     tokens = _tokens(b=2, l=16)
@@ -55,6 +57,7 @@ def test_auto_dispatch_uses_ring_when_seq_sharded(seq_runtime):
     )
 
 
+@pytest.mark.slow
 def test_lm_train_step_dp_sp_tp(seq_runtime):
     """Full training step: ZeRO-3 + TP rules + sequence-parallel ring
     attention, one jitted step on the dp x sp x tp mesh."""

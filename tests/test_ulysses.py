@@ -43,6 +43,7 @@ def test_ulysses_rejects_indivisible_heads():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ulysses_gradients_match(causal):
     mesh = MeshSpec(data=2, seq=4).build()
     q, k, v = _qkv()
@@ -59,6 +60,7 @@ def test_ulysses_gradients_match(causal):
         np.testing.assert_allclose(np.asarray(gs), np.asarray(gr), atol=5e-5)
 
 
+@pytest.mark.slow
 def test_transformer_ulysses_matches_full():
     """TransformerLM forward with attn_impl='ulysses' == 'full' on the
     same params (the model-level dispatch contract)."""

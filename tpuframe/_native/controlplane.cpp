@@ -422,7 +422,10 @@ void hb_reader(HbMonitor* m, int fd, int rank) {
       break;
     }
   }
-  close(fd);
+  // fd deliberately NOT closed here: its number stays in m->conns, and
+  // closing would let the process reuse the number for an unrelated
+  // socket that destroy()'s shutdown pass would then break.  destroy
+  // closes every conn exactly once after joining readers.
 }
 
 void hb_acceptor(HbMonitor* m) {
@@ -557,6 +560,8 @@ void tfhb_monitor_destroy(void* h) {
   }
   for (auto& t : m->readers)
     if (t.joinable()) t.join();
+  // close only after every reader has exited (readers never close)
+  for (int fd : m->conns) close(fd);
   if (m->listen_fd >= 0) close(m->listen_fd);
   delete m;
 }

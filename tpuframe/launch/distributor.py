@@ -326,21 +326,25 @@ class Distributor:
             or os.environ.get("TPUFRAME_CP_TOKEN")
             or secrets.token_hex(16)
         )
-        monitor = None
-        if self.heartbeat_timeout_s and self.num_processes > 1:
-            try:
-                from tpuframe.core.native import HeartbeatMonitor
-
-                self._hb_port = self._free_port()
-                monitor = HeartbeatMonitor(
-                    self._hb_port, self.num_processes, token=self._cp_token
-                )
-            except Exception:
-                monitor, self._hb_port = None, None  # liveness is best-effort
         with tempfile.TemporaryDirectory(prefix="tpuframe_launch_") as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             with open(payload, "wb") as f:
                 cloudpickle.dump((fn, args, kwargs), f)
+
+            # created immediately before the try whose finally closes it —
+            # an earlier failure (unpicklable fn, say) must not leak the
+            # monitor's thread + bound port
+            monitor = None
+            if self.heartbeat_timeout_s and self.num_processes > 1:
+                try:
+                    from tpuframe.core.native import HeartbeatMonitor
+
+                    self._hb_port = self._free_port()
+                    monitor = HeartbeatMonitor(
+                        self._hb_port, self.num_processes, token=self._cp_token
+                    )
+                except Exception:
+                    monitor, self._hb_port = None, None  # best-effort
 
             procs: list[tuple[int, subprocess.Popen, str]] = []
             stderr_files = []

@@ -339,9 +339,7 @@ class RemoteDistributor:
                 # its orphan watchdog before our kill lands — that's
                 # self-inflicted, not a root cause
                 self_inflicted=(*_KILL_CODES, ORPHANED_EXIT),
-                health_check=_stale_rank_check(
-                    monitor, self.heartbeat_timeout_s
-                ),
+                health_check=self._drained_aware_check(monitor, workers),
             )
         finally:
             self._kill_and_reap(workers)
@@ -368,6 +366,20 @@ class RemoteDistributor:
         if w0.outcome["ok"]:
             return w0.outcome["value"]
         raise w0.outcome["error"]
+
+    def _drained_aware_check(self, monitor, workers: Sequence[_Worker]):
+        """Heartbeat check that ignores ranks whose result frame already
+        arrived: a cleanly-finished agent's beacon goes silent while the
+        transport (ssh) may keep draining a large frame for a while — that
+        rank has succeeded, not vanished."""
+        base = _stale_rank_check(monitor, self.heartbeat_timeout_s)
+        if base is None:
+            return None
+
+        def check(pending_ranks):
+            return base({r for r in pending_ranks if workers[r].outcome is None})
+
+        return check
 
     @staticmethod
     def _kill_and_reap(workers: Sequence[_Worker]) -> None:
