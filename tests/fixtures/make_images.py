@@ -1,0 +1,61 @@
+"""Generate the committed real-JPEG test fixture (run once; outputs are
+checked in so the suite never depends on this script or on network).
+
+100 tiny real JPEGs — actual JFIF files that exercise the PIL decode path
+end to end (`tests/test_real_images.py`), matching the reference's
+real-image ingest (`/root/reference/utils/hf_dataset_utilities.py:8-81`,
+`.../03a_tiny_imagenet_torch_distributor_resnet_mds.py:180-224`) without
+needing its HF downloads.  Four classes with distinct textures (plus
+noise and phase jitter) so a small model can genuinely *learn* them:
+
+  0: horizontal stripes   1: vertical stripes
+  2: checkerboard         3: radial gradient
+
+Usage: python tests/fixtures/make_images.py
+"""
+
+import os
+
+import numpy as np
+from PIL import Image
+
+SIZE = 32
+PER_CLASS = 25
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "images")
+
+
+def texture(cls: int, rng: np.random.Generator) -> np.ndarray:
+    y, x = np.mgrid[0:SIZE, 0:SIZE]
+    phase = rng.uniform(0, 2 * np.pi)
+    freq = rng.uniform(0.6, 1.4)
+    if cls == 0:
+        base = np.sin(y * freq + phase)
+    elif cls == 1:
+        base = np.sin(x * freq + phase)
+    elif cls == 2:
+        base = np.sign(np.sin(y * freq + phase) * np.sin(x * freq + phase))
+    else:
+        r = np.hypot(y - SIZE / 2, x - SIZE / 2)
+        base = np.sin(r * freq + phase)
+    img = np.stack([base] * 3, axis=-1)
+    tint = rng.uniform(0.6, 1.0, size=(1, 1, 3))
+    img = (img * 0.5 + 0.5) * tint
+    img = img + rng.normal(0, 0.08, img.shape)
+    return (np.clip(img, 0, 1) * 255).astype(np.uint8)
+
+
+def main() -> None:
+    rng = np.random.default_rng(20260730)
+    for cls in range(4):
+        d = os.path.join(OUT, f"class_{cls}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(PER_CLASS):
+            Image.fromarray(texture(cls, rng)).save(
+                os.path.join(d, f"img_{i:03d}.jpg"), format="JPEG", quality=90
+            )
+    n = sum(len(fs) for _, _, fs in os.walk(OUT) if fs)
+    print(f"wrote {n} JPEGs under {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Real-image L1 acceptance: committed JPEG fixtures through the FULL
+data path — PIL decode -> ShardWriter -> (remote->local fetch) ->
+StreamingDataset -> DataLoader -> Trainer to an accuracy threshold.
+
+The reference exercises its pipeline against real HF images
+(`/root/reference/utils/hf_dataset_utilities.py:8-81`,
+`.../03a_tiny_imagenet_torch_distributor_resnet_mds.py:180-276`); this is
+the same proof without its network dependency: ``tests/fixtures/images``
+holds 100 real JFIF files (4 texture classes, see fixtures/make_images.py)
+small enough to commit.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tpuframe.data import DataLoader
+from tpuframe.data.streaming import ShardWriter, StreamingDataset, clean_stale_cache
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "images")
+
+
+def _ingest():
+    """HF-imagefolder-shaped ingest: (path, label) per sample, labels from
+    directory names, deterministic order."""
+    samples = []
+    for cls_dir in sorted(os.listdir(FIXTURES)):
+        label = int(cls_dir.rsplit("_", 1)[1])
+        d = os.path.join(FIXTURES, cls_dir)
+        for name in sorted(os.listdir(d)):
+            samples.append((os.path.join(d, name), label))
+    return samples
+
+
+def test_fixture_is_real_jpeg():
+    samples = _ingest()
+    assert len(samples) == 100
+    with open(samples[0][0], "rb") as f:
+        magic = f.read(3)
+    assert magic == b"\xff\xd8\xff"  # JFIF SOI marker, not a renamed array
+    arr = np.asarray(Image.open(samples[0][0]))
+    assert arr.shape == (32, 32, 3) and arr.dtype == np.uint8
+
+
+def test_original_jpeg_bytes_roundtrip_byte_exact(tmp_path):
+    """Ingest can store the ORIGINAL encoded file bytes; the shard
+    round-trip must return them byte-identical (and therefore decode to
+    the identical pixels)."""
+    samples = _ingest()[:10]
+    out = str(tmp_path / "shards")
+    with ShardWriter(out, columns={"image": "bytes", "label": "int"}) as w:
+        for path, label in samples:
+            with open(path, "rb") as f:
+                w.write({"image": f.read(), "label": label})
+
+    ds = StreamingDataset(out)
+    for i, (path, label) in enumerate(samples):
+        rec = ds.sample(i)
+        with open(path, "rb") as f:
+            original = f.read()
+        assert rec["image"] == original  # byte-exact through zstd + msgpack
+        assert rec["label"] == label
+        np.testing.assert_array_equal(
+            np.asarray(Image.open(path)), np.asarray(Image.open(__import__("io").BytesIO(rec["image"])))
+        )
+
+
+@pytest.mark.slow
+def test_real_images_ingest_shard_stream_train_learns(tmp_path):
+    """The whole L1 story on actual images: PIL decode -> multi-shard
+    write -> remote->local cache fetch -> streamed decode -> Trainer
+    reaches >85% train accuracy (chance 25%) in 6 epochs."""
+    from tpuframe.models import ResNet18
+    from tpuframe.train import Trainer
+
+    samples = _ingest()
+    remote = str(tmp_path / "remote_shards")
+    # small shard cap -> several shards, so the fetch/LRU paths really run
+    with ShardWriter(
+        remote, columns={"image": "jpg", "label": "int"}, shard_size_limit=1 << 15
+    ) as w:
+        for path, label in samples:
+            w.write({"image": np.asarray(Image.open(path)), "label": label})
+
+    import json
+
+    index = json.load(open(os.path.join(remote, "index.json")))
+    assert index["total"] == 100 and len(index["shards"]) >= 3
+
+    cache = str(tmp_path / "local_cache")
+    # a stale partial download from a "killed run" must get cleaned
+    os.makedirs(cache)
+    open(os.path.join(cache, "shard.00000.tfs.tmp"), "w").close()
+    assert clean_stale_cache(cache) == 1
+
+    def normalize(img, rng):
+        return img.astype(np.float32) / 255.0 * 2.0 - 1.0
+
+    ds = StreamingDataset(remote, local_cache=cache, transform=normalize)
+    assert len(ds) == 100
+    img0, label0 = ds[0]
+    assert img0.shape == (32, 32, 3) and img0.dtype == np.float32
+    assert label0 == 0
+
+    trainer = Trainer(
+        ResNet18(num_classes=4, stem="cifar"),
+        train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=0),
+        max_duration="6ep",
+        lr=3e-3,
+        optimizer="adamw",
+        eval_interval=0,
+        log_interval=0,
+    )
+    result = trainer.fit()
+    assert result.metrics["train_accuracy"] > 0.85, result.metrics
+
+    # the streamed path really went remote->local: shards were fetched
+    fetched = [f for f in os.listdir(cache) if f.endswith(".tfs")]
+    assert len(fetched) == len(index["shards"])

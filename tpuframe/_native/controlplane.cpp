@@ -408,7 +408,7 @@ struct HbMonitor {
   std::vector<std::thread> readers;
 };
 
-void hb_reader(HbMonitor* m, int fd, int rank) {
+void hb_reader(HbMonitor* m, int fd, int rank, size_t conn_idx) {
   // 1-second receive slices so stop is honored promptly
   set_rcvtimeo(fd, 1000);
   while (!m->stop.load()) {
@@ -417,15 +417,17 @@ void hb_reader(HbMonitor* m, int fd, int rank) {
     if (r == 1) {
       m->last_seen[rank].store(now_ms());
     } else if (r == 0) {
-      break;  // beacon closed (worker exited)
+      break;  // beacon closed (worker exited or reconnecting)
     } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
       break;
     }
   }
-  // fd deliberately NOT closed here: its number stays in m->conns, and
-  // closing would let the process reuse the number for an unrelated
-  // socket that destroy()'s shutdown pass would then break.  destroy
-  // closes every conn exactly once after joining readers.
+  // close under the mutex AND retire the conns slot: reconnecting
+  // beacons must not leak one fd per flap, and destroy()'s shutdown
+  // pass must never touch a number the process has since reused.
+  std::lock_guard<std::mutex> lock(m->mu);
+  close(fd);
+  m->conns[conn_idx] = -1;
 }
 
 void hb_acceptor(HbMonitor* m) {
@@ -451,7 +453,7 @@ void hb_acceptor(HbMonitor* m) {
       return;
     }
     m->conns.push_back(cfd);
-    m->readers.emplace_back(hb_reader, m, cfd, (int)rank);
+    m->readers.emplace_back(hb_reader, m, cfd, (int)rank, m->conns.size() - 1);
   }
 }
 
@@ -556,12 +558,12 @@ void tfhb_monitor_destroy(void* h) {
   if (m->acceptor.joinable()) m->acceptor.join();
   {
     std::lock_guard<std::mutex> lock(m->mu);
-    for (int fd : m->conns) shutdown(fd, SHUT_RDWR);
+    for (int fd : m->conns)
+      if (fd >= 0) shutdown(fd, SHUT_RDWR);  // -1 = reader already retired it
   }
   for (auto& t : m->readers)
     if (t.joinable()) t.join();
-  // close only after every reader has exited (readers never close)
-  for (int fd : m->conns) close(fd);
+  // every reader closed+retired its own slot on exit; nothing left to close
   if (m->listen_fd >= 0) close(m->listen_fd);
   delete m;
 }

@@ -84,6 +84,7 @@ def await_and_root_cause(
     describe_timeout: Callable[[int], str],
     self_inflicted: Sequence[int] = _KILL_CODES,
     health_check: Callable[[set], BaseException | None] | None = None,
+    finished_check: Callable[[set], bool] | None = None,
     poll_interval_s: float = 0.2,
 ) -> None:
     """Shared wait loop for local and remote launchers.
@@ -94,8 +95,11 @@ def await_and_root_cause(
     ``deadline``; once one has failed, hung peers get only
     ``_FAILURE_GRACE_S``, not the rest of the deadline.  ``health_check``
     (heartbeat staleness, typically) receives the set of still-pending
-    ranks and may return an exception to declare one lost.  On deadline,
-    ``kill_all()`` then
+    ranks and may return an exception to declare one lost.
+    ``finished_check`` may declare the run logically complete (every
+    pending rank's result already in hand — a wedged transport mustn't
+    turn a finished run into a TimeoutError); the stragglers are killed
+    and the wait returns success.  On deadline, ``kill_all()`` then
     scan for a *crashed* peer (excluding ``self_inflicted`` codes — our
     own kill, or a remote agent's orphan-watchdog exit) — the usual
     distributed-crash shape is one dead rank with everyone else hung at a
@@ -120,6 +124,10 @@ def await_and_root_cause(
             if code != 0 and failure is None:
                 failure = make_failure(rank, code, extra)
                 grace_deadline = time.monotonic() + _FAILURE_GRACE_S
+        if pending and failure is None and finished_check is not None:
+            if finished_check(set(pending)):
+                kill_all()  # reap wedged-but-result-delivered transports
+                return
         if pending and failure is None and health_check is not None:
             lost = health_check(set(pending))
             if lost is not None:

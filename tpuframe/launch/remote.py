@@ -340,6 +340,13 @@ class RemoteDistributor:
                 # self-inflicted, not a root cause
                 self_inflicted=(*_KILL_CODES, ORPHANED_EXIT),
                 health_check=self._drained_aware_check(monitor, workers),
+                # every pending rank's SUCCESS frame already in hand means
+                # only transports linger; don't let them ride to timeout
+                finished_check=lambda pending: all(
+                    workers[r].outcome is not None
+                    and workers[r].outcome.get("ok")
+                    for r in pending
+                ),
             )
         finally:
             self._kill_and_reap(workers)
