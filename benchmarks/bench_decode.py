@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Input-pipeline microbench: 224px JPEG decode + augment throughput.
+
+SURVEY §7 names "input pipeline feeding HBM at ImageNet rate" a hard
+part: the v5e chip consumes ~2.2k images/sec/chip (measured, PERF.md),
+and the host has to decode+augment that fast.  This measures the actual
+DataLoader fetch path (PIL decode -> resize/flip -> float32 normalize)
+inline vs thread workers vs process workers, and reports img/s total and
+per core.
+
+Prints ONE JSON line.  Working set: the committed 32px fixture JPEGs
+upscaled once to 256px JPEGs in a temp dir, so the measurement is
+network-free and deterministic.
+
+Usage: python benchmarks/bench_decode.py [--images 200] [--seconds 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, "tests", "fixtures", "images"
+)
+#: measured chip ingest, ResNet50 224px bf16 on one v5e (PERF.md)
+CHIP_INGEST_IMG_S = 2238.0
+
+
+class JpegFolder:
+    """Map-style dataset over JPEG paths: decode + augment per item —
+    exactly the per-sample work an ImageNet loader does."""
+
+    def __init__(self, paths, size: int = 224, seed: int = 0):
+        self.paths = list(paths)
+        self.size = size
+        self.seed = seed
+
+    def __len__(self):
+        return len(self.paths)
+
+    def __getitem__(self, idx: int):
+        from PIL import Image
+
+        from tpuframe.data.datasets import item_rng
+
+        rng = item_rng(self.seed, 0, idx)
+        with Image.open(self.paths[idx]) as im:
+            im = im.convert("RGB")
+            # random resized crop, ImageNet-style
+            w, h = im.size
+            scale = rng.uniform(0.6, 1.0)
+            cw, ch = int(w * scale), int(h * scale)
+            x0 = int(rng.integers(0, w - cw + 1))
+            y0 = int(rng.integers(0, h - ch + 1))
+            im = im.crop((x0, y0, x0 + cw, y0 + ch)).resize(
+                (self.size, self.size), Image.BILINEAR
+            )
+            arr = np.asarray(im, np.float32)
+        if rng.random() < 0.5:
+            arr = arr[:, ::-1]
+        mean = np.array([0.485, 0.456, 0.406], np.float32) * 255
+        std = np.array([0.229, 0.224, 0.225], np.float32) * 255
+        return (arr - mean) / std, idx % 1000
+
+
+def _make_working_set(n: int, tmp: str) -> list[str]:
+    from PIL import Image
+
+    src = []
+    for d in sorted(os.listdir(FIXTURES)):
+        for f in sorted(os.listdir(os.path.join(FIXTURES, d))):
+            src.append(os.path.join(FIXTURES, d, f))
+    paths = []
+    for i in range(n):
+        with Image.open(src[i % len(src)]) as im:
+            big = im.resize((256, 256), Image.BILINEAR)
+        p = os.path.join(tmp, f"img_{i:04d}.jpg")
+        big.save(p, format="JPEG", quality=85)
+        paths.append(p)
+    return paths
+
+
+def _measure(loader, seconds: float) -> float:
+    """img/s sustained over >= `seconds` of wall clock (>=1 full epoch)."""
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        for batch in loader:
+            n += len(batch[1])
+        if time.perf_counter() - t0 >= seconds:
+            break
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=200)
+    ap.add_argument("--seconds", type=float, default=8.0)
+    args = ap.parse_args()
+
+    from tpuframe.data import DataLoader
+
+    cores = os.cpu_count() or 1
+    with tempfile.TemporaryDirectory(prefix="tpuframe_decbench_") as tmp:
+        ds = JpegFolder(_make_working_set(args.images, tmp))
+        batch = 32
+
+        def loader(**kw):
+            return DataLoader(
+                ds, batch, process_index=0, process_count=1, **kw
+            )
+
+        results = {}
+        results["inline"] = _measure(loader(), args.seconds)
+        results[f"threads_{cores}"] = _measure(
+            loader(num_workers=cores), args.seconds
+        )
+        lp = loader(num_workers=cores, worker_mode="process")
+        try:
+            results[f"processes_{cores}"] = _measure(lp, args.seconds)
+        finally:
+            lp.close()
+
+    best_mode, best = max(results.items(), key=lambda kv: kv[1])
+    print(
+        json.dumps(
+            {
+                "metric": "imagenet224_decode_augment_images_per_sec",
+                "value": round(best, 1),
+                "unit": f"images/sec ({best_mode}, {cores} cores, batch {batch})",
+                "per_core": round(best / cores, 1),
+                "modes": {k: round(v, 1) for k, v in results.items()},
+                "chip_ingest_img_s": CHIP_INGEST_IMG_S,
+                # cores one host needs to keep ONE v5e chip fed at the
+                # measured train rate
+                "cores_to_feed_chip": round(CHIP_INGEST_IMG_S / (best / cores), 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -257,3 +257,34 @@ def test_heartbeat_detects_worker_behind_lingering_transport(tmp_path):
     elapsed = time.monotonic() - t0
     assert exc_info.value.rank == 1
     assert elapsed < 60, f"detection took {elapsed:.1f}s"
+
+
+def _rank1_raises():
+    if os.environ["RANK"] == "1":
+        raise ValueError("delivered failure frame")
+    return "ok"
+
+
+@pytest.mark.slow
+def test_wedged_transport_failure_frame_surfaces(tmp_path):
+    """A FAILURE frame delivered just before the transport wedges must
+    surface as the typed exception promptly — not ride to TimeoutError."""
+    import stat
+    import time
+
+    wrapper = tmp_path / "lingering_python.sh"
+    wrapper.write_text(
+        f"#!/bin/sh\n{sys.executable} \"$@\"\nsleep 60\nexit 0\n"
+    )
+    wrapper.chmod(wrapper.stat().st_mode | stat.S_IEXEC)
+    rd = RemoteDistributor(
+        ["hostA", "hostB"],
+        connect=lambda host: list(_LOCAL),
+        remote_python=str(wrapper),
+        master_addr="127.0.0.1",
+        timeout_s=300.0,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="delivered failure frame"):
+        rd.run(_rank1_raises)
+    assert time.monotonic() - t0 < 60

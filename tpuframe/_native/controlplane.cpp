@@ -403,9 +403,9 @@ struct HbMonitor {
   std::unique_ptr<std::atomic<int64_t>[]> last_seen;  // now_ms, or -1 never
   std::atomic<bool> stop{false};
   std::thread acceptor;
-  std::mutex mu;                 // guards conns/readers
-  std::vector<int> conns;
-  std::vector<std::thread> readers;
+  std::mutex mu;  // guards conns/readers
+  std::vector<int> conns;  // slot == -1: retired, reusable
+  std::vector<std::pair<std::thread, size_t>> readers;  // (thread, conn slot)
 };
 
 void hb_reader(HbMonitor* m, int fd, int rank, size_t conn_idx) {
@@ -452,8 +452,28 @@ void hb_acceptor(HbMonitor* m) {
       close(cfd);
       return;
     }
-    m->conns.push_back(cfd);
-    m->readers.emplace_back(hb_reader, m, cfd, (int)rank, m->conns.size() - 1);
+    // reap finished readers + reuse their retired slot: a flapping
+    // beacon reconnecting for days must not grow threads/slots unboundedly
+    for (auto it = m->readers.begin(); it != m->readers.end();) {
+      if (m->conns[it->second] == -1) {
+        if (it->first.joinable()) it->first.join();  // already exited
+        it = m->readers.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    size_t slot = m->conns.size();
+    for (size_t i = 0; i < m->conns.size(); ++i)
+      if (m->conns[i] == -1) {
+        slot = i;
+        break;
+      }
+    if (slot == m->conns.size())
+      m->conns.push_back(cfd);
+    else
+      m->conns[slot] = cfd;
+    m->readers.emplace_back(std::thread(hb_reader, m, cfd, (int)rank, slot),
+                            slot);
   }
 }
 
@@ -561,8 +581,8 @@ void tfhb_monitor_destroy(void* h) {
     for (int fd : m->conns)
       if (fd >= 0) shutdown(fd, SHUT_RDWR);  // -1 = reader already retired it
   }
-  for (auto& t : m->readers)
-    if (t.joinable()) t.join();
+  for (auto& r : m->readers)
+    if (r.first.joinable()) r.first.join();
   // every reader closed+retired its own slot on exit; nothing left to close
   if (m->listen_fd >= 0) close(m->listen_fd);
   delete m;

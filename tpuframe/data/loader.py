@@ -20,6 +20,7 @@ mask (eval) so XLA never recompiles.
 
 from __future__ import annotations
 
+import multiprocessing
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -29,6 +30,33 @@ import jax
 import numpy as np
 
 from tpuframe.core import runtime as rt
+
+# Process-pool workers inherit the dataset via fork (copy-on-write — no
+# per-item pickling of the dataset, only of the returned samples).  A
+# module global is the one channel fork-inherited state can ride.
+_WORKER_DATASET = None
+_WORKER_EPOCH = None
+
+
+def _pool_init(dataset) -> None:
+    global _WORKER_DATASET, _WORKER_EPOCH
+    _WORKER_DATASET = dataset
+    _WORKER_EPOCH = None
+
+
+def _pool_get(args):
+    # epoch rides along with every request: the worker's dataset snapshot
+    # never sees the parent's set_epoch calls, and epoch drives per-item
+    # augmentation rngs (StreamingDataset.item_rng).  The shadow var — not
+    # a dataset attribute probe — decides staleness, so set_epoch runs
+    # once per epoch per worker regardless of how the dataset stores it.
+    global _WORKER_EPOCH
+    idx, epoch = args
+    if epoch != _WORKER_EPOCH:
+        if hasattr(_WORKER_DATASET, "set_epoch"):
+            _WORKER_DATASET.set_epoch(epoch)
+        _WORKER_EPOCH = epoch
+    return _WORKER_DATASET[int(idx)]
 
 
 class DataLoader:
@@ -43,7 +71,19 @@ class DataLoader:
       drop_last: drop the trailing ragged batch (train default).  When False,
         the last batch is padded to full size and a boolean ``valid`` mask is
         yielded as third element (static shapes for jit-eval).
-      num_workers: thread pool size for item fetch/transform (0 = inline).
+      num_workers: worker pool size for item fetch/transform (0 = inline).
+      worker_mode: ``"thread"`` (default — fine when decode releases the
+        GIL and transforms are light) or ``"process"`` — a persistent
+        pool that sidesteps the GIL entirely for numpy-heavy
+        augmentation at ImageNet rates (SURVEY §7 "Input pipeline feeding
+        HBM").  Process mode needs picklable *samples*.
+      mp_context: process-pool start method.  ``"fork"`` (default, the
+        torch-DataLoader convention) inherits the dataset copy-on-write —
+        no pickling — but forking a process that already imported jax
+        draws a deadlock warning; workers must therefore never touch jax
+        (ours only touch the dataset).  ``"forkserver"``/``"spawn"``
+        avoid that entirely but pickle the dataset once at pool creation
+        (StreamingDataset pickles fine; locks/caches are re-created).
     """
 
     def __init__(
@@ -55,15 +95,25 @@ class DataLoader:
         seed: int = 0,
         drop_last: bool = True,
         num_workers: int = 0,
+        worker_mode: str = "thread",
+        mp_context: str = "fork",
         process_index: int | None = None,
         process_count: int | None = None,
     ):
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(
+                f"worker_mode must be 'thread' or 'process', got {worker_mode!r}"
+            )
+        multiprocessing.get_context(mp_context)  # fail at init, not mid-train
+        self.mp_context = mp_context
         self.dataset = dataset
         self.global_batch_size = int(batch_size)
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
         self.num_workers = num_workers
+        self.worker_mode = worker_mode
+        self._proc_pool = None
         self._epoch = 0
         self.process_index = (
             rt.process_index() if process_index is None else process_index
@@ -121,17 +171,52 @@ class DataLoader:
             return per_proc // self.local_batch_size
         return -(-per_proc // self.local_batch_size)
 
+    def _process_pool(self):
+        """Persistent fork pool, created on first use, reused across epochs
+        (recreating per epoch would pay fork + page-fault warmup each time)."""
+        if self._proc_pool is None:
+            ctx = multiprocessing.get_context(self.mp_context)
+            self._proc_pool = ctx.Pool(
+                self.num_workers, initializer=_pool_init, initargs=(self.dataset,)
+            )
+        return self._proc_pool
+
+    def close(self) -> None:
+        """Release the persistent process pool (no-op otherwise)."""
+        if self._proc_pool is not None:
+            self._proc_pool.terminate()
+            self._proc_pool.join()
+            self._proc_pool = None
+
+    def __del__(self):  # best-effort: pools must not outlive the loader
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def __iter__(self) -> Iterator[tuple]:
         indices, genuine = self._indices()
         nb_full = len(indices) // self.local_batch_size
         tail = len(indices) % self.local_batch_size
 
-        pool = ThreadPoolExecutor(self.num_workers) if self.num_workers else None
-        # plain Python ints: torch-style datasets (the reference's map-style
-        # Dataset contract) often reject numpy integer indices
-        get = lambda i: self.dataset[int(i)]  # noqa: E731
-        fetch = (lambda idxs: list(pool.map(get, idxs))) if pool \
-            else (lambda idxs: [get(i) for i in idxs])
+        pool = None
+        if self.num_workers and self.worker_mode == "process":
+            # chunked map: one IPC round per worker-chunk, not per item
+            ppool = self._process_pool()
+            chunk = max(1, self.local_batch_size // (self.num_workers * 2))
+            epoch = self._epoch
+            fetch = lambda idxs: ppool.map(  # noqa: E731
+                _pool_get, [(int(i), epoch) for i in idxs], chunksize=chunk
+            )
+        elif self.num_workers:
+            pool = ThreadPoolExecutor(self.num_workers)
+            fetch = lambda idxs: list(  # noqa: E731
+                pool.map(lambda i: self.dataset[int(i)], idxs)
+            )
+        else:
+            # plain Python ints: torch-style datasets (the reference's
+            # map-style Dataset contract) often reject numpy indices
+            fetch = lambda idxs: [self.dataset[int(i)] for i in idxs]  # noqa: E731
         try:
             for b in range(nb_full):
                 sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)

@@ -277,6 +277,20 @@ class StreamingDataset:
         self._decoded: OrderedDict[int, list] = OrderedDict()
         self._decoded_cap = max(1, decoded_cache_shards)
 
+    def __getstate__(self):
+        # "dataset handles, not dataset bytes, cross the process boundary"
+        # (SURVEY §3.2): the handle pickles; the lock and decoded-shard LRU
+        # are per-process and rebuilt on arrival
+        state = self.__dict__.copy()
+        state["_lock"] = None
+        state["_decoded"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        self._decoded = OrderedDict()
+
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
 

@@ -340,14 +340,20 @@ class RemoteDistributor:
                 # self-inflicted, not a root cause
                 self_inflicted=(*_KILL_CODES, ORPHANED_EXIT),
                 health_check=self._drained_aware_check(monitor, workers),
-                # every pending rank's SUCCESS frame already in hand means
-                # only transports linger; don't let them ride to timeout
+                # every pending rank's result frame (success OR failure)
+                # already in hand means only transports linger; don't let
+                # them ride the run to timeout — the outcome scan below
+                # raises any delivered failure
                 finished_check=lambda pending: all(
-                    workers[r].outcome is not None
-                    and workers[r].outcome.get("ok")
-                    for r in pending
+                    workers[r].outcome is not None for r in pending
                 ),
             )
+            # a failure frame delivered by a worker whose transport wedged
+            # never produced a nonzero exit for make_failure to see — scan
+            # for it so the real exception surfaces, not a timeout
+            for w in workers:
+                if w.outcome is not None and not w.outcome.get("ok", True):
+                    raise self._worker_failure(w, w.proc.returncode or 0)
         finally:
             self._kill_and_reap(workers)
             for w in workers:
