@@ -33,9 +33,8 @@ def main(argv=None):
     train_ds, eval_ds = make_datasets(args)
     train_loader, eval_loader = make_loaders(args, train_ds, eval_ds)
 
-    logger = MLflowLogger(
-        "composer_cifar", tracking_uri=os.path.join(args.workdir, "composer", "mlruns")
-    )
+    tracking_uri = os.path.join(args.workdir, "composer", "mlruns")
+    logger = MLflowLogger("composer_cifar", tracking_uri=tracking_uri)
     trainer = Trainer(
         ResNet50(num_classes=args.num_classes, stem="cifar"),
         optimizer="adam",
@@ -54,12 +53,34 @@ def main(argv=None):
     result = trainer.fit()
     print("fit:", result.metrics)
 
-    # model registry + reload + single-image inference (cell-16..18)
+    # model registry + reload + single-image inference (cell-16..18):
+    # log -> register a named version -> alias -> reload by models:/ URI,
+    # the MLFlowLogger(model_registry_uri='databricks-uc') capability
     model_dir = logger.log_model(trainer.state, artifact_path="model")
+    run = logger.run  # flush() ends + detaches the run; keep the handle
     logger.flush()
+    import jax
+
+    from tpuframe.track import ModelRegistry, load_model
+
+    reg = ModelRegistry(tracking_uri)
+    version = reg.register_model(run, "cifar-composer-resnet")
+    reg.set_alias("cifar-composer-resnet", "champion", version.version)
+    reloaded = load_model(
+        "models:/cifar-composer-resnet@champion",
+        template=trainer.state,
+        tracking_uri=tracking_uri,
+    )
+    assert np.allclose(
+        np.asarray(jax.tree.leaves(reloaded["params"])[0]),
+        np.asarray(jax.tree.leaves(trainer.state.params)[0]),
+    )
     img, label = eval_ds[0]
     logits = trainer.predict(np.asarray(img)[None])
-    print(f"demo: label={label} pred={int(np.argmax(logits))} model@{model_dir}")
+    print(
+        f"demo: label={label} pred={int(np.argmax(logits))} "
+        f"model@{model_dir} registered=v{version.version}@champion"
+    )
     assert result.error is None
 
 

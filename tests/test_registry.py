@@ -1,0 +1,215 @@
+"""Model registry: versions, aliases, latest, models:/ URIs, reload
+round-trip, and the HTTP mirror — the Composer example's
+``model_registry_uri='databricks-uc'`` capability
+(`/root/reference/03_composer/01_cifar_composer_resnet.ipynb:cell-16`)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from tpuframe.track import ExperimentTracker, ModelRegistry, load_model
+from tpuframe.track.registry import HttpModelRegistry, parse_models_uri
+
+
+def _params(scale: float):
+    return {"dense": {"kernel": np.full((3, 2), scale, np.float32)}}
+
+
+def _logged_run(tmp_path, scale=1.0):
+    from types import SimpleNamespace
+
+    tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+    tracker.set_experiment("reg-test")
+    run = tracker.start_run(run_name=f"r{scale}")
+    run.log_model(SimpleNamespace(params=_params(scale), batch_stats={}))
+    run.end()
+    return run
+
+
+def test_register_versions_increment_and_latest(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "mlruns"))
+    r1, r2 = _logged_run(tmp_path, 1.0), _logged_run(tmp_path, 2.0)
+    v1 = reg.register_model(r1, "cifar-resnet")
+    v2 = reg.register_model(r2, "cifar-resnet")
+    assert (v1.version, v2.version) == (1, 2)
+    assert v1.run_id == r1.run_id and v2.run_id == r2.run_id
+    assert reg.versions("cifar-resnet") == [1, 2]
+    assert reg.latest("cifar-resnet").version == 2
+    assert reg.list_models() == ["cifar-resnet"]
+
+
+def test_alias_set_steal_delete_and_lookup(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "mlruns"))
+    reg.register_model(_logged_run(tmp_path, 1.0), "m")
+    reg.register_model(_logged_run(tmp_path, 2.0), "m")
+    reg.set_alias("m", "champion", 1)
+    assert reg.get("m", "@champion").version == 1
+    assert reg.get("m", 1).aliases == ("champion",)
+    reg.set_alias("m", "champion", 2)  # reassign steals
+    assert reg.get("m", "@champion").version == 2
+    reg.delete_alias("m", "champion")
+    with pytest.raises(KeyError, match="champion"):
+        reg.get("m", "@champion")
+
+
+def test_reload_round_trip_exact(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "mlruns"))
+    reg.register_model(_logged_run(tmp_path, 3.5), "m")
+    tree = reg.load("m", template={"params": _params(0.0)})
+    np.testing.assert_array_equal(
+        tree["params"]["dense"]["kernel"], _params(3.5)["dense"]["kernel"]
+    )
+
+
+def test_registry_survives_run_deletion(tmp_path):
+    """The registry snapshots artifacts — GC'ing the run must not break
+    registered versions (the self-contained property MLflow's registry
+    store has)."""
+    import shutil
+
+    reg = ModelRegistry(str(tmp_path / "mlruns"))
+    run = _logged_run(tmp_path, 7.0)
+    reg.register_model(run, "m")
+    shutil.rmtree(run.artifact_dir)  # simulate run GC
+    tree = reg.load("m", template={"params": _params(0.0)})
+    assert tree["params"]["dense"]["kernel"][0, 0] == 7.0
+
+
+def test_models_uri_parse_and_load(tmp_path):
+    assert parse_models_uri("models:/m/3") == ("m", 3)
+    assert parse_models_uri("models:/m@champ") == ("m", "@champ")
+    assert parse_models_uri("models:/m") == ("m", "latest")
+    with pytest.raises(ValueError):
+        parse_models_uri("runs:/abc/model")
+
+    reg = ModelRegistry(str(tmp_path / "mlruns"))
+    reg.register_model(_logged_run(tmp_path, 1.0), "m")
+    reg.register_model(_logged_run(tmp_path, 9.0), "m")
+    reg.set_alias("m", "champ", 2)
+    tree = load_model(
+        "models:/m@champ",
+        template={"params": _params(0.0)},
+        tracking_uri=str(tmp_path / "mlruns"),
+    )
+    assert tree["params"]["dense"]["kernel"][0, 0] == 9.0
+
+
+def test_unknown_refs_raise_helpfully(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "mlruns"))
+    with pytest.raises(KeyError, match="no registered model"):
+        reg.get("ghost")
+    reg.register_model(_logged_run(tmp_path, 1.0), "m")
+    with pytest.raises(KeyError, match="no version 9"):
+        reg.get("m", 9)
+    with pytest.raises(ValueError, match="unresolvable"):
+        reg.get("m", "not-a-ref")
+    with pytest.raises(FileNotFoundError, match="log_model"):
+        tracker = ExperimentTracker(str(tmp_path / "mlruns"))
+        tracker.set_experiment("reg-test")
+        empty = tracker.start_run()
+        reg.register_model(empty, "m2")
+
+
+def test_registry_dir_does_not_shadow_experiments(tmp_path):
+    """The models/ dir lives inside the mlruns root; experiment listing
+    must keep ignoring it."""
+    root = str(tmp_path / "mlruns")
+    reg = ModelRegistry(root)
+    reg.register_model(_logged_run(tmp_path, 1.0), "m")
+    tracker = ExperimentTracker(root)
+    assert tracker.set_experiment("reg-test") == tracker._experiments()["reg-test"]
+
+
+# --- HTTP mirror against a mock MLflow registry ---------------------------
+
+
+class MockRegistry(BaseHTTPRequestHandler):
+    store = None
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        return json.loads(
+            self.rfile.read(int(self.headers.get("Content-Length", 0))) or b"{}"
+        )
+
+    def do_POST(self):
+        s = self.server.store
+        p = self._body()
+        if self.path.endswith("/registered-models/create"):
+            if p["name"] in s["models"]:
+                self._json(400, {"error_code": "RESOURCE_ALREADY_EXISTS"})
+            else:
+                s["models"][p["name"]] = {"versions": [], "aliases": {}}
+                self._json(200, {"registered_model": {"name": p["name"]}})
+        elif self.path.endswith("/model-versions/create"):
+            m = s["models"][p["name"]]
+            v = len(m["versions"]) + 1
+            m["versions"].append(
+                {"version": str(v), "run_id": p.get("run_id"),
+                 "source": p["source"], "creation_timestamp": 123}
+            )
+            self._json(200, {"model_version": m["versions"][-1]})
+        elif self.path.endswith("/registered-models/alias"):
+            s["models"][p["name"]]["aliases"][p["alias"]] = p["version"]
+            self._json(200, {})
+        elif self.path.endswith("/registered-models/get-latest-versions"):
+            m = s["models"][p["name"]]
+            self._json(200, {"model_versions": [m["versions"][-1]]})
+        else:
+            self._json(404, {"error_code": "ENDPOINT_NOT_FOUND"})
+
+    def do_GET(self):
+        import urllib.parse
+
+        s = self.server.store
+        url = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(url.query).items()}
+        if url.path.endswith("/registered-models/alias"):
+            m = s["models"][q["name"]]
+            v = m["aliases"][q["alias"]]
+            self._json(200, {"model_version": m["versions"][int(v) - 1]})
+        elif url.path.endswith("/model-versions/get"):
+            m = s["models"][q["name"]]
+            self._json(200, {"model_version": m["versions"][int(q["version"]) - 1]})
+        else:
+            self._json(404, {"error_code": "ENDPOINT_NOT_FOUND"})
+
+
+@pytest.fixture()
+def registry_server():
+    server = HTTPServer(("127.0.0.1", 0), MockRegistry)
+    server.store = {"models": {}}
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def test_http_registry_mirror(registry_server):
+    base = f"http://127.0.0.1:{registry_server.server_address[1]}"
+    reg = HttpModelRegistry(base)
+
+    class _R:
+        run_id = "run-42"
+
+    v1 = reg.register_model(_R(), "m", artifact_path="model")
+    assert v1.version == 1 and v1.source == "runs:/run-42/model"
+    v2 = reg.register_model(_R(), "m")  # create-if-exists tolerated
+    assert v2.version == 2
+    assert reg.latest("m").version == 2
+    reg.set_alias("m", "champion", 1)
+    assert reg.get("m", "@champion").version == 1
+    assert reg.get("m", 2).version == 2

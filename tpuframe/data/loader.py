@@ -115,6 +115,11 @@ class DataLoader:
         self.worker_mode = worker_mode
         self._proc_pool = None
         self._epoch = 0
+        if num_workers and worker_mode == "process":
+            # Fork NOW, from the constructing (main) thread — a lazy fork
+            # from DevicePrefetcher's background thread while jax/XLA
+            # threads hold locks is the classic child-deadlock setup.
+            self._process_pool()
         self.process_index = (
             rt.process_index() if process_index is None else process_index
         )

@@ -25,6 +25,12 @@ from tpuframe.track.mlflow_store import (
 )
 from tpuframe.track.http_store import HttpExperimentTracker, HttpRun, make_tracker
 from tpuframe.track.profiler import ProfilerCallback, StepTimer, trace, trace_step_window
+from tpuframe.track.registry import (
+    HttpModelRegistry,
+    ModelRegistry,
+    ModelVersion,
+    load_model,
+)
 from tpuframe.track.system_metrics import SystemMetricsMonitor
 
 __all__ = [
@@ -37,6 +43,10 @@ __all__ = [
     "SystemMetricsMonitor",
     "HttpExperimentTracker",
     "HttpRun",
+    "HttpModelRegistry",
+    "ModelRegistry",
+    "ModelVersion",
+    "load_model",
     "make_tracker",
     "ProfilerCallback",
     "StepTimer",
