@@ -88,33 +88,57 @@ def train_zero(cfg: dict, zero_config: ZeroConfig | None = None):
     train_step = make_train_step()
     eval_step = make_eval_step()
 
+    # the reference's tensorboard block (`deepspeed_config.py:42-46`),
+    # functional here: rank 0 writes real event files
+    from tpuframe.track.tensorboard import from_deepspeed_config
+
+    tb = None
+    if rt.is_main:
+        tb = from_deepspeed_config({
+            "tensorboard": {
+                "enabled": True,
+                "output_path": os.path.join(cfg["workdir"], "tensorboard"),
+                "job_name": f"zero{zero_config.stage}",
+            }
+        })
+
     best_val, patience_left = float("inf"), cfg["patience"]
     history = []
-    for epoch in range(cfg["epochs"]):
-        train_loader.set_epoch(epoch)
-        acc = None
-        for images, labels in train_loader:
-            batch = plan.shard_batch({"image": images, "label": labels})
-            state, metrics = train_step(state, batch)
-            acc = merge_metrics(acc, metrics)
-        summary = summarize_metrics(acc or {}, "train_")
+    try:
+        for epoch in range(cfg["epochs"]):
+            train_loader.set_epoch(epoch)
+            acc = None
+            for images, labels in train_loader:
+                batch = plan.shard_batch({"image": images, "label": labels})
+                state, metrics = train_step(state, batch)
+                acc = merge_metrics(acc, metrics)
+            summary = summarize_metrics(acc or {}, "train_")
 
-        vacc = None
-        for images, labels, mask in val_loader:
-            batch = plan.shard_batch({"image": images, "label": labels, "weight": mask})
-            vacc = merge_metrics(vacc, eval_step(state, batch))
-        summary.update(summarize_metrics(vacc or {}, "val_"))
-        history.append(summary)
-        if rt.is_main:
-            print(f"epoch {epoch}: {summary}")
+            vacc = None
+            for images, labels, mask in val_loader:
+                batch = plan.shard_batch(
+                    {"image": images, "label": labels, "weight": mask}
+                )
+                vacc = merge_metrics(vacc, eval_step(state, batch))
+            summary.update(summarize_metrics(vacc or {}, "val_"))
+            history.append(summary)
+            if rt.is_main:
+                print(f"epoch {epoch}: {summary}")
+            if tb is not None:
+                tb.log_metrics(summary, step=epoch)
 
-        # early stopping, patience like `02_tiny_imagenet_...py:289-297`
-        if summary["val_loss"] < best_val - cfg["min_delta"]:
-            best_val, patience_left = summary["val_loss"], cfg["patience"]
-        else:
-            patience_left -= 1
-            if patience_left <= 0:
-                break
+            # early stopping, patience like `02_tiny_imagenet_...py:289-297`
+            if summary["val_loss"] < best_val - cfg["min_delta"]:
+                best_val, patience_left = summary["val_loss"], cfg["patience"]
+            else:
+                patience_left -= 1
+                if patience_left <= 0:
+                    break
+    finally:
+        # a mid-epoch crash in a ZeroDistributor worker must not lose the
+        # epochs already written (mirrors Trainer's finally-based finish)
+        if tb is not None:
+            tb.close()
     return {"stage": zero_config.stage, "epochs_ran": len(history), **history[-1]}
 
 
@@ -139,6 +163,7 @@ def main(argv=None):
         "patience": args.patience,
         "min_delta": 1e-4,
         "fsdp": args.fsdp,
+        "workdir": os.path.join(args.workdir, "deepspeed"),
     }
     dist = ZeroDistributor(
         num_processes=args.num_processes,

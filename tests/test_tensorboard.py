@@ -1,0 +1,172 @@
+"""TensorBoard sink: the event file must be the REAL format — verified by
+an independent decoder in this test (TFRecord framing with masked crc32c
++ protobuf Event/Summary wire layout), not by round-tripping through the
+writer's own code.  Covers the reference's DeepSpeed tensorboard block
+(`/root/reference/02_deepspeed/deepspeed_config.py:42-46`)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from tpuframe.track import TensorBoardLogger
+from tpuframe.track.tensorboard import _crc32c, from_deepspeed_config
+
+
+# --- independent decoder (no imports from the writer's encode path) -------
+
+def _read_records(path):
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off < len(data):
+        (length,) = struct.unpack_from("<Q", data, off)
+        header = data[off:off + 8]
+        (len_crc,) = struct.unpack_from("<I", data, off + 8)
+        payload = data[off + 12:off + 12 + length]
+        (payload_crc,) = struct.unpack_from("<I", data, off + 12 + length)
+        for blob, crc in ((header, len_crc), (payload, payload_crc)):
+            c = _crc32c(blob)
+            masked = (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+            assert masked == crc, "crc mismatch — TensorBoard would reject this"
+        out.append(payload)
+        off += 12 + length + 4
+    return out
+
+
+def _decode_fields(buf):
+    """Protobuf wire decode -> {field_num: [values]}."""
+    fields = {}
+    off = 0
+    while off < len(buf):
+        key = 0
+        shift = 0
+        while True:
+            b = buf[off]
+            off += 1
+            key |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        num, wire = key >> 3, key & 7
+        if wire == 0:  # varint
+            val = 0
+            shift = 0
+            while True:
+                b = buf[off]
+                off += 1
+                val |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        elif wire == 1:  # 64-bit
+            (val,) = struct.unpack_from("<d", buf, off)
+            off += 8
+        elif wire == 2:  # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[off]
+                off += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            val = buf[off:off + ln]
+            off += ln
+        elif wire == 5:  # 32-bit
+            (val,) = struct.unpack_from("<f", buf, off)
+            off += 4
+        else:  # pragma: no cover
+            raise AssertionError(f"unexpected wire type {wire}")
+        fields.setdefault(num, []).append(val)
+    return fields
+
+
+def _scalars(event_payload):
+    ev = _decode_fields(event_payload)
+    out = {}
+    for summary in ev.get(5, []):
+        for value in _decode_fields(summary).get(1, []):
+            v = _decode_fields(value)
+            out[v[1][0].decode()] = v[2][0]
+    return ev.get(2, [0])[0], out  # (step, {tag: value})
+
+
+def test_event_file_format_and_scalars(tmp_path):
+    tb = TensorBoardLogger(str(tmp_path), job_name="job1")
+    tb.log_metrics({"loss": 0.5, "acc": 0.875}, step=3)
+    tb.log_metrics({"loss": 0.25}, step=7)
+    tb.close()
+
+    records = _read_records(tb.path)
+    assert len(records) == 3
+    header = _decode_fields(records[0])
+    assert header[3][0] == b"brain.Event:2"  # file_version
+    assert header[1][0] > 1e9  # wall_time is epoch seconds
+
+    step, scalars = _scalars(records[1])
+    assert step == 3
+    assert scalars["loss"] == pytest.approx(0.5)
+    assert scalars["acc"] == pytest.approx(0.875)
+    step2, scalars2 = _scalars(records[2])
+    assert step2 == 7 and scalars2 == {"loss": pytest.approx(0.25)}
+
+
+def test_crc32c_known_vectors():
+    # published crc32c test vectors (RFC 3720 appendix B.4 style)
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_non_numeric_metrics_skipped_numpy_scalars_kept(tmp_path):
+    tb = TensorBoardLogger(str(tmp_path))
+    tb.log_metrics(
+        {"loss": 1.0, "np_loss": np.float32(0.5), "note": "hi", "flag": True},
+        step=1,
+    )
+    tb.close()
+    _, scalars = _scalars(_read_records(tb.path)[1])
+    assert scalars == {"loss": pytest.approx(1.0), "np_loss": pytest.approx(0.5)}
+
+
+def test_from_deepspeed_config_block(tmp_path):
+    # the reference's exact block shape (`deepspeed_config.py:42-46`)
+    cfg = {
+        "tensorboard": {
+            "enabled": True,
+            "output_path": str(tmp_path / "tb"),
+            "job_name": "ds_job",
+        }
+    }
+    tb = from_deepspeed_config(cfg)
+    assert tb is not None and "ds_job" in tb.logdir
+    tb.close()
+    assert from_deepspeed_config({}) is None
+    assert from_deepspeed_config({"tensorboard": {"enabled": False}}) is None
+
+
+def test_trainer_logger_plugin(tmp_path):
+    """Drops into Trainer(loggers=[...]) next to the MLflow logger."""
+    from tpuframe.data import DataLoader, SyntheticImageDataset
+    from tpuframe.models import MnistNet
+    from tpuframe.train import Trainer
+
+    tb = TensorBoardLogger(str(tmp_path), job_name="trainer")
+    ds = SyntheticImageDataset(n=32, image_size=28, channels=1, num_classes=4)
+    Trainer(
+        MnistNet(num_classes=4),
+        train_dataloader=DataLoader(ds, batch_size=8),
+        max_duration="1ep",
+        loggers=[tb],
+        log_interval=1,
+        eval_interval=0,
+    ).fit()
+    records = _read_records(tb.path)
+    assert len(records) > 1
+    tags = set()
+    for rec in records[1:]:
+        tags.update(_scalars(rec)[1])
+    assert any("loss" in t for t in tags), tags

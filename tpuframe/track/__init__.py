@@ -31,6 +31,7 @@ from tpuframe.track.registry import (
     ModelVersion,
     load_model,
 )
+from tpuframe.track.tensorboard import TensorBoardLogger
 from tpuframe.track.system_metrics import SystemMetricsMonitor
 
 __all__ = [
@@ -48,6 +49,7 @@ __all__ = [
     "ModelVersion",
     "load_model",
     "make_tracker",
+    "TensorBoardLogger",
     "ProfilerCallback",
     "StepTimer",
     "trace",
