@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""TPU perf experiments: A/B the HBM-traffic levers on the real chip.
+
+Run on TPU hardware (the axon tunnel here; any chip via plain `python`).
+Measures the ResNet50 224px bf16 train step — the PERF.md headline — in
+several configurations and prints one JSON line per config:
+
+  baseline      bf16 policy, BN outputs f32 (r02's 2237.7 img/s shape)
+  bn_bf16       norm_dtype=bf16: BN emits bf16, killing the f32
+                BN->relu->conv activation traffic (PERF.md headroom item)
+  batch_256     baseline at batch 256 (sweep point)
+  bn_bf16_b256  both
+
+Each record carries img/s, MFU, and XLA cost-analysis bytes so PERF.md's
+roofline table can attribute the delta.  Safe to re-run: the persistent
+compile cache (JAX_COMPILATION_CACHE_DIR) makes repeats cheap.
+
+Usage: python benchmarks/bench_tpu_experiments.py [--steps 30] [--configs a,b]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+CONFIGS = {
+    "baseline": dict(batch=128, norm_bf16=False),
+    "bn_bf16": dict(batch=128, norm_bf16=True),
+    "batch_256": dict(batch=256, norm_bf16=False),
+    "bn_bf16_b256": dict(batch=256, norm_bf16=True),
+}
+
+
+def run_config(name: str, cfg: dict, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.models import ResNet50
+    from tpuframe.parallel import ParallelPlan, align_model_dtype, bf16_compute
+    from tpuframe.train import create_train_state, make_train_step
+
+    policy = bf16_compute()
+    model = align_model_dtype(
+        ResNet50(
+            num_classes=1000,
+            norm_dtype=jnp.bfloat16 if cfg["norm_bf16"] else None,
+        ),
+        policy,
+    )
+    plan = ParallelPlan(mesh=MeshSpec(data=-1).build())
+    state = create_train_state(
+        model,
+        jax.random.PRNGKey(0),
+        jnp.ones((1, 224, 224, 3), jnp.float32),
+        optax.sgd(0.1, momentum=0.9),
+        plan=plan,
+        init_kwargs={"train": False},
+    )
+    rng = np.random.default_rng(0)
+    batch = plan.shard_batch(
+        {
+            "image": rng.standard_normal((cfg["batch"], 224, 224, 3)).astype(
+                np.float32
+            ),
+            "label": rng.integers(0, 1000, (cfg["batch"],)).astype(np.int32),
+        }
+    )
+    compiled = make_train_step(policy).lower(state, batch).compile()
+    flops = bytes_accessed = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        # some PJRT plugins report -1 "unknown": only positives are real
+        f = float(ca.get("flops", -1.0)) if ca else -1.0
+        b = float(ca.get("bytes accessed", -1.0)) if ca else -1.0
+        flops = f if f > 0 else None
+        bytes_accessed = b if b > 0 else None
+    except Exception:
+        pass
+
+    for _ in range(2):
+        state, metrics = compiled(state, batch)
+    jax.block_until_ready((state, metrics))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = compiled(state, batch)
+        jax.block_until_ready((state, metrics))
+        rates.append(cfg["batch"] * steps / (time.perf_counter() - t0))
+    assert np.isfinite(float(metrics["loss_sum"]))
+    img_s = sorted(rates)[1]
+    # bench.py owns the device-kind -> peak-FLOPs table; a silent CPU
+    # fallback must be visible in the record, not attributed to the chip
+    # (the BENCH_r02 lesson)
+    import bench as headline_bench
+
+    backend = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
+    peak = headline_bench._peak_flops(device_kind) if backend != "cpu" else None
+    return {
+        "config": name,
+        "batch": cfg["batch"],
+        "backend": backend,
+        "device_kind": device_kind,
+        "images_per_sec": round(img_s, 1),
+        "mfu": (
+            round(flops * img_s / cfg["batch"] / peak, 4)
+            if flops and peak
+            else None
+        ),
+        "hbm_gb_per_step": round(bytes_accessed / 1e9, 2) if bytes_accessed else None,
+        "step_ms": round(cfg["batch"] / img_s * 1000, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--configs", default="baseline,bn_bf16")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tpuframe_xla_cache")
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+        )
+    except Exception:
+        pass
+    print(f"# backend={jax.default_backend()} devices={jax.devices()}", file=sys.stderr)
+    for name in args.configs.split(","):
+        name = name.strip()
+        out = run_config(name, CONFIGS[name], args.steps)
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -123,3 +123,45 @@ class TestResNetBnStats:
         result = tr.fit()
         assert result.error is None
         assert np.isfinite(result.metrics["train_loss"])
+
+
+@pytest.mark.parametrize(
+    "bn_kwargs",
+    [{}, {"bn_stats": "local", "bn_groups": 2}],
+    ids=["sync", "local-grouped"],
+)
+def test_norm_dtype_keeps_f32_stats_and_close_outputs(bn_kwargs):
+    """norm_dtype=bf16 changes only the BN OUTPUT dtype — on BOTH the
+    sync (nn.BatchNorm) and local (ReplicaGroupedBatchNorm) branches:
+    running stats stay f32 (internal promotion) and the forward stays
+    numerically close to the f32-output baseline (PERF.md HBM-traffic
+    experiment)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuframe.models import ResNet18
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 32, 32, 3)),
+                    jnp.float32)
+    base = ResNet18(num_classes=8, stem="cifar", dtype=jnp.bfloat16, **bn_kwargs)
+    fast = ResNet18(num_classes=8, stem="cifar", dtype=jnp.bfloat16,
+                    norm_dtype=jnp.bfloat16, **bn_kwargs)
+    v = base.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out_base = base.apply(v, x, train=False)
+    out_fast = fast.apply(v, x, train=False)  # same params: only BN output dtype differs
+    assert out_base.dtype == out_fast.dtype == jnp.float32  # head casts back
+    # bf16 rounding accumulates over 18 layers; require agreement at the
+    # scale of the logits (|out| ~ 30 here), not elementwise tightness
+    scale = float(np.abs(np.asarray(out_base)).max())
+    np.testing.assert_allclose(
+        np.asarray(out_base), np.asarray(out_fast), atol=0.1 * scale
+    )
+
+    # train-mode mutation: running statistics must still be f32
+    out, mut = fast.apply(
+        v, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    for leaf in jax.tree.leaves(mut["batch_stats"]):
+        assert leaf.dtype == jnp.float32, leaf.dtype

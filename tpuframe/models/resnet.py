@@ -120,6 +120,14 @@ class ResNet(nn.Module):
       bn_groups: statistic groups for ``bn_stats="local"`` (0 = treat as
             sync; the Trainer auto-fills it with the plan's data shard
             count).
+      norm_dtype: BatchNorm OUTPUT dtype.  None (default) keeps f32
+            outputs — numerically identical to torch's BN-in-f32 and the
+            behavior of earlier rounds.  Setting ``norm_dtype=dtype``
+            (bf16) keeps statistics/affine math in f32 inside flax's BN
+            (``_compute_stats`` promotes) but emits bf16 activations, so
+            the BN→relu→conv chain stops materializing f32 tensors — on
+            an HBM-bound step that traffic is the headroom PERF.md
+            identifies.  Convergence-relevant: measure before defaulting.
     """
 
     stage_sizes: Sequence[int]
@@ -131,6 +139,7 @@ class ResNet(nn.Module):
     act: Callable = nn.relu
     bn_stats: str = "sync"
     bn_groups: int = 0
+    norm_dtype: jnp.dtype | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -141,6 +150,9 @@ class ResNet(nn.Module):
             padding="SAME",
             kernel_init=nn.initializers.he_normal(),
         )
+        # stats/affine math stays f32 either way (flax promotes inside);
+        # norm_dtype only picks the OUTPUT dtype of the normalize
+        bn_out_dtype = self.norm_dtype if self.norm_dtype is not None else jnp.float32
         if self.bn_stats == "local" and self.bn_groups > 1:
             from tpuframe.models.norm import ReplicaGroupedBatchNorm
 
@@ -150,9 +162,9 @@ class ResNet(nn.Module):
                 groups=self.bn_groups,
                 momentum=0.9,
                 epsilon=1e-5,
-                # f32 output like the sync branch: the bn_stats knob must
-                # toggle ONLY the statistics scope, not activation dtype
-                dtype=jnp.float32,
+                # the bn_stats knob must toggle ONLY the statistics scope,
+                # not activation dtype — that's norm_dtype's job
+                dtype=bn_out_dtype,
             )
         elif self.bn_stats in ("sync", "local"):
             norm = functools.partial(
@@ -160,7 +172,7 @@ class ResNet(nn.Module):
                 use_running_average=not train,
                 momentum=0.9,
                 epsilon=1e-5,
-                dtype=jnp.float32,  # statistics + affine in f32 for stability
+                dtype=bn_out_dtype,
             )
         else:
             raise ValueError(
