@@ -60,6 +60,52 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
+def cost_analysis(compiled) -> tuple[float | None, float | None]:
+    """(flops, bytes accessed) per device-step from XLA cost analysis.
+    Positives only — some PJRT plugins omit entries or report the -1
+    "unknown" sentinel."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = float(ca.get("flops", -1.0)) if ca else -1.0
+        b = float(ca.get("bytes accessed", -1.0)) if ca else -1.0
+        return (f if f > 0 else None, b if b > 0 else None)
+    except Exception:
+        return (None, None)
+
+
+def time_train_step(compiled, state, data, *, batch: int, steps: int,
+                    rounds: int = 3):
+    """Median images/sec over ``rounds`` timed windows of ``steps`` steps.
+
+    Warms up twice, blocks on the FULL output pytree each round (guards
+    against async-dispatch artifacts where blocking on one small output
+    under-reports wall time), and asserts the step counter really
+    advanced.  Returns ``(images_per_sec, final_state, final_metrics)``.
+    The one timing methodology for bench.py and the perf-experiment
+    harness — fixes here reach both.
+    """
+    import jax
+    import numpy as np
+
+    for _ in range(2):
+        state, metrics = compiled(state, data)
+    jax.block_until_ready((state, metrics))
+    rates = []
+    for _ in range(rounds):
+        step_before = int(state.step)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = compiled(state, data)
+        jax.block_until_ready((state, metrics))
+        elapsed = time.perf_counter() - t0
+        assert int(state.step) == step_before + steps
+        rates.append(batch * steps / elapsed)
+    assert np.isfinite(float(metrics["loss_sum"]))
+    return sorted(rates)[len(rates) // 2], state, metrics
+
+
 def _run_bench() -> None:
     import jax
 
@@ -128,44 +174,16 @@ def _run_bench() -> None:
     # the standard analytic ResNet50 count as fallback (~4.09 GFLOP
     # forward/image at 224px, x3 for fwd+bwd, divided over chips).
     compiled = step_fn.lower(state, data).compile()
-    flops_per_dev_step: float | None = None
-    bytes_per_dev_step: float | None = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", -1.0)) if ca else -1.0
-        if flops > 0:
-            flops_per_dev_step = flops
-        nbytes = float(ca.get("bytes accessed", -1.0)) if ca else -1.0
-        if nbytes > 0:
-            bytes_per_dev_step = nbytes
-    except Exception:
-        pass
+    flops_per_dev_step, bytes_per_dev_step = cost_analysis(compiled)
     if flops_per_dev_step is None and size == 224:
+        # standard analytic ResNet50 count (~4.09 GFLOP fwd/image at
+        # 224px, x3 for fwd+bwd, divided over chips)
         flops_per_dev_step = 3 * 4.09e9 * batch / chips
 
-    # Warmup (settles caches and async dispatch).
-    for _ in range(2):
-        state, metrics = compiled(state, data)
-    jax.block_until_ready((state, metrics))
-
-    # Median-of-rounds with a joint block on the full output pytree each
-    # round: guards against async-dispatch/tunnel artifacts where blocking
-    # on one small output under-reports wall time.
-    rates = []
-    for _ in range(3):
-        step_before = int(state.step)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = compiled(state, data)
-        jax.block_until_ready((state, metrics))
-        elapsed = time.perf_counter() - t0
-        assert int(state.step) == step_before + steps
-        rates.append(batch * steps / elapsed)
-    assert np.isfinite(float(metrics["loss_sum"]))
-
-    value = sorted(rates)[len(rates) // 2] / chips
+    global_img_s, state, metrics = time_train_step(
+        compiled, state, data, batch=batch, steps=steps
+    )
+    value = global_img_s / chips
 
     device_kind = jax.devices()[0].device_kind
     peak = _peak_flops(device_kind) if on_accel else None

@@ -73,37 +73,16 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
             "label": rng.integers(0, 1000, (cfg["batch"],)).astype(np.int32),
         }
     )
-    compiled = make_train_step(policy).lower(state, batch).compile()
-    flops = bytes_accessed = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        # some PJRT plugins report -1 "unknown": only positives are real
-        f = float(ca.get("flops", -1.0)) if ca else -1.0
-        b = float(ca.get("bytes accessed", -1.0)) if ca else -1.0
-        flops = f if f > 0 else None
-        bytes_accessed = b if b > 0 else None
-    except Exception:
-        pass
-
-    for _ in range(2):
-        state, metrics = compiled(state, batch)
-    jax.block_until_ready((state, metrics))
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = compiled(state, batch)
-        jax.block_until_ready((state, metrics))
-        rates.append(cfg["batch"] * steps / (time.perf_counter() - t0))
-    assert np.isfinite(float(metrics["loss_sum"]))
-    img_s = sorted(rates)[1]
-    # bench.py owns the device-kind -> peak-FLOPs table; a silent CPU
-    # fallback must be visible in the record, not attributed to the chip
-    # (the BENCH_r02 lesson)
+    # bench.py owns the measurement methodology (timing windows, cost
+    # analysis, device-kind peak table); a silent CPU fallback must be
+    # visible in the record, not attributed to the chip (BENCH_r02 lesson)
     import bench as headline_bench
 
+    compiled = make_train_step(policy).lower(state, batch).compile()
+    flops, bytes_accessed = headline_bench.cost_analysis(compiled)
+    img_s, state, _metrics = headline_bench.time_train_step(
+        compiled, state, batch, batch=cfg["batch"], steps=steps
+    )
     backend = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
     peak = headline_bench._peak_flops(device_kind) if backend != "cpu" else None
@@ -138,6 +117,17 @@ def main() -> None:
         )
     except Exception:
         pass
+    # tiny-compile preflight (bench.py's): a wedged remote-compile helper
+    # hangs compiles forever — fail visibly in bounded time instead
+    import bench as headline_bench
+
+    verdict, detail = headline_bench._preflight(dict(os.environ), 180.0)
+    if verdict != "ok":
+        print(
+            json.dumps({"error": f"backend preflight {verdict}: {detail}"}),
+            flush=True,
+        )
+        raise SystemExit(1)
     print(f"# backend={jax.default_backend()} devices={jax.devices()}", file=sys.stderr)
     for name in args.configs.split(","):
         name = name.strip()

@@ -1,0 +1,135 @@
+"""TRUE multi-process jax.distributed tests: N processes, each with its
+own local device, joined into ONE global mesh with cross-process
+collectives — the DCN-equivalent compute path a real pod uses
+(`core/runtime.py` `jax.distributed.initialize` branch), which the
+single-process 8-virtual-device suite cannot reach.
+
+These spawn jax-importing subprocesses; marked slow."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from tpuframe.launch import Distributor, RemoteDistributor
+
+
+def _collective_worker():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuframe import core
+
+    rt = core.initialize({"data": -1})
+    local = np.full((1, 4), rt.process_index + 1, np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(rt.mesh, P("data", None)), local
+    )
+    return {
+        "procs": rt.process_count,
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "sum": float(jax.jit(lambda x: x.sum())(arr)),
+    }
+
+
+def test_two_process_global_mesh_collective():
+    """Two processes x one device each -> a 2-device global mesh whose
+    reduction really crosses the process boundary."""
+    out = Distributor(num_processes=2, simulate_devices=1, timeout_s=600).run(
+        _collective_worker
+    )
+    assert out["procs"] == 2
+    assert out["global_devices"] == 2 and out["local_devices"] == 1
+    assert out["sum"] == 4 * (1 + 2)  # both processes' contributions
+
+
+def _train_worker():
+    """A real sharded train step over the cross-process mesh: grads
+    all-reduce over DCN-equivalent transport, params stay in sync."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from tpuframe import core
+    from tpuframe.parallel import ParallelPlan
+    from tpuframe.train import create_train_state, make_train_step
+
+    rt = core.initialize({"data": -1})
+    plan = ParallelPlan(mesh=rt.mesh)
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    state = create_train_state(
+        Tiny(), jax.random.PRNGKey(0), jnp.ones((1, 8, 8, 1), jnp.float32),
+        optax.sgd(0.05), plan=plan,
+    )
+    step = make_train_step()
+    # every process feeds ITS half of the global batch (deterministic,
+    # rank-dependent), like a sharded DataLoader would
+    rng = np.random.default_rng(rt.process_index)
+    losses = []
+    for i in range(5):
+        global_batch = {
+            "image": rng.standard_normal((8, 8, 8, 1)).astype(np.float32),
+            "label": rng.integers(0, 4, (8,)).astype(np.int32),
+        }
+        batch = plan.shard_batch(global_batch)
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss_sum"]))
+    # params must be identical on every process after synced updates —
+    # asserted HERE with a cross-process allgather (rank 0's view alone
+    # could not tell a silent per-process desync from sync)
+    digest = float(
+        sum(jnp.sum(jnp.abs(p)) for p in jax.tree.leaves(state.params))
+    )
+    from jax.experimental import multihost_utils
+
+    digests = np.asarray(
+        multihost_utils.process_allgather(np.float64(digest))
+    ).ravel()
+    assert digests.size == rt.process_count
+    np.testing.assert_allclose(digests, digests[0], rtol=1e-6)
+    return {
+        "rank": rt.process_index,
+        "losses": losses,
+        "digests": digests.tolist(),
+    }
+
+
+def test_two_process_sharded_train_step():
+    import numpy as np
+
+    out = Distributor(num_processes=2, simulate_devices=1, timeout_s=600).run(
+        _train_worker
+    )
+    assert np.isfinite(out["losses"]).all()
+    assert out["losses"][-1] < out["losses"][0]
+    assert len(out["digests"]) == 2  # the in-worker allgather sync check ran
+
+
+def test_remote_distributor_full_multihost_train():
+    """The whole multi-host story at once: per-host agents over an exec
+    transport + env contract + jax.distributed rendezvous + cross-process
+    gradient all-reduce + rank-0 result aggregation."""
+    import sys
+
+    import numpy as np
+
+    rd = RemoteDistributor(
+        ["hostA", "hostB"],
+        connect=lambda host: ["env", "PALLAS_AXON_POOL_IPS=", "JAX_PLATFORMS=cpu"],
+        remote_python=sys.executable,
+        master_addr="127.0.0.1",
+        simulate_devices=1,
+        timeout_s=600.0,
+    )
+    out = rd.run(_train_worker)
+    assert out["rank"] == 0
+    assert np.isfinite(out["losses"]).all()
+    assert out["losses"][-1] < out["losses"][0]
