@@ -133,3 +133,73 @@ def test_remote_distributor_full_multihost_train():
     assert out["rank"] == 0
     assert np.isfinite(out["losses"]).all()
     assert out["losses"][-1] < out["losses"][0]
+
+
+def _report_loop(config):
+    import os
+
+    from tpuframe.launch import report
+
+    report({"rank_sum": float(os.environ["RANK"]) + config["base"]})
+    return "ok"
+
+
+def test_tpu_trainer_scaling_config_hosts(tmp_path):
+    """Ray-shaped TPUTrainer places workers via the remote path when
+    ScalingConfig.hosts is set (shared-fs storage, like Ray's /dbfs)."""
+    import sys
+
+    from tpuframe.launch import RunConfig, ScalingConfig, TPUTrainer
+
+    trainer = TPUTrainer(
+        _report_loop,
+        train_loop_config={"base": 10.0},
+        scaling_config=ScalingConfig(
+            hosts=["hostA", "hostB"],
+            remote_kwargs={
+                "connect": lambda host: [
+                    "env", "PALLAS_AXON_POOL_IPS=", "JAX_PLATFORMS=cpu",
+                ],
+                "remote_python": sys.executable,
+                "master_addr": "127.0.0.1",
+            },
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="remote"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rank_sum"] == 10.0  # rank 0's report wins
+
+
+def test_tpu_trainer_hosts_user_env_and_worker_count_guard(tmp_path):
+    """A user env= in remote_kwargs must merge with (not clobber) the
+    result-dir contract, and a num_workers/hosts mismatch must raise."""
+    import sys
+
+    from tpuframe.launch import RunConfig, ScalingConfig, TPUTrainer
+
+    result = TPUTrainer(
+        _report_loop,
+        train_loop_config={"base": 5.0},
+        scaling_config=ScalingConfig(
+            hosts=["hostA", "hostB"],
+            remote_kwargs={
+                "connect": lambda host: [
+                    "env", "PALLAS_AXON_POOL_IPS=", "JAX_PLATFORMS=cpu",
+                ],
+                "remote_python": sys.executable,
+                "master_addr": "127.0.0.1",
+                "env": {"MY_CREDENTIAL": "sekret"},  # user-supplied env
+            },
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="envmerge"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["rank_sum"] == 5.0  # report() still reached the dir
+
+    with pytest.raises(ValueError, match="num_processes"):
+        TPUTrainer(
+            _report_loop,
+            scaling_config=ScalingConfig(num_workers=4, hosts=["a", "b"]),
+            run_config=RunConfig(storage_path=str(tmp_path), name="mismatch"),
+        ).fit()

@@ -37,11 +37,18 @@ _RESULT_DIR_ENV = "TPUFRAME_RESULT_DIR"
 
 @dataclasses.dataclass
 class ScalingConfig:
-    """≈ ``ray.train.ScalingConfig(num_workers, use_gpu)`` (cell-7)."""
+    """≈ ``ray.train.ScalingConfig(num_workers, use_gpu)`` (cell-7).
+
+    ``hosts`` switches placement from local processes to one rank per
+    remote host via :class:`~tpuframe.launch.RemoteDistributor` (Ray's
+    ``setup_ray_cluster(max_worker_nodes=...)`` role); ``remote_kwargs``
+    passes transport options (``connect``, ports, ``remote_python``)."""
 
     num_workers: int = 1
     use_tpu: bool = True
     simulate_devices: int | None = None
+    hosts: list[str] | None = None
+    remote_kwargs: dict | None = None
 
 
 @dataclasses.dataclass
@@ -248,11 +255,26 @@ class TPUTrainer:
                 prefix=f"run_{time.strftime('%Y%m%d_%H%M%S')}_", dir=storage
             )
 
-        dist = Distributor(
+        kw: dict = dict(
             num_processes=self.scaling.num_workers,
             simulate_devices=self.scaling.simulate_devices,
             env={_RESULT_DIR_ENV: result_dir},
         )
+        if self.scaling.hosts:
+            # one rank per host (Ray's cluster-placement role).  report()
+            # aggregation reads the result dir, so storage_path must be a
+            # filesystem every host shares — the same contract as Ray's
+            # /dbfs storage_path (`05_ray/01_...ipynb:cell-7`).
+            rk = dict(self.scaling.remote_kwargs or {})
+            # the result-dir var must survive a user-supplied env= (their
+            # credentials etc. merge IN, they don't replace the contract)
+            rk["env"] = {**kw.pop("env"), **rk.get("env", {}),
+                         _RESULT_DIR_ENV: result_dir}
+            kw.update(local_mode=False, hosts=list(self.scaling.hosts),
+                      remote_kwargs=rk)
+            # num_workers defaults to 1; an explicit value must agree with
+            # the host list (Distributor validates)
+        dist = Distributor(**kw)
         error: BaseException | None = None
         try:
             if self._loop_takes_config:
