@@ -1,0 +1,149 @@
+"""Quantized gradient all-reduce (EQuARX-style int8 payloads,
+tpuframe.parallel.compression): numerical closeness to the exact psum,
+end-to-end training through make_train_step(grad_compression="int8"),
+and the pure-DP guard rails."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import MeshSpec
+from tpuframe.parallel import ParallelPlan
+from tpuframe.parallel.compression import quantized_pmean
+from tpuframe.train import create_train_state, make_train_step
+
+
+def _mesh(n=8):
+    return MeshSpec(data=n).build()
+
+
+def test_quantized_pmean_close_to_exact():
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    # shard-varying gradients with very different magnitudes per leaf
+    tree = {
+        "big": jnp.asarray(rng.standard_normal((8, 64)) * 50, jnp.float32),
+        "small": jnp.asarray(rng.standard_normal((8, 32)) * 1e-4, jnp.float32),
+        "count": jnp.ones((8,), jnp.int32),
+    }
+
+    def qmean(t):
+        return quantized_pmean(t, ("data",))
+
+    out = jax.shard_map(
+        qmean, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False,
+    )(tree)
+    for key in ("big", "small"):
+        exact = np.broadcast_to(
+            np.asarray(tree[key]).mean(axis=0, keepdims=True), tree[key].shape
+        )
+        got = np.asarray(out[key])
+        amax = np.abs(np.asarray(tree[key])).max()
+        # one int8 grid step of the shared scale is the error bound
+        np.testing.assert_allclose(got, exact, atol=amax / 127 + 1e-12)
+    # integer leaves psum exactly
+    np.testing.assert_array_equal(np.asarray(out["count"]), np.full((8,), 8))
+
+
+def test_quantized_pmean_zero_grads_no_nan():
+    mesh = _mesh()
+    out = jax.shard_map(
+        lambda t: quantized_pmean(t, ("data",)),
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False,
+    )({"g": jnp.zeros((8, 16), jnp.float32)})
+    assert np.isfinite(np.asarray(out["g"])).all()
+    np.testing.assert_array_equal(np.asarray(out["g"]), 0.0)
+
+
+def _tiny_state(plan, seed=0):
+    from flax import linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Dense(16)(x.reshape((x.shape[0], -1)))
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    return create_train_state(
+        Tiny(), jax.random.PRNGKey(seed), jnp.ones((1, 6, 6, 1), jnp.float32),
+        optax.adam(1e-2), plan=plan,
+    )
+
+
+_W_TRUE = np.random.default_rng(7).standard_normal((36, 4)).astype(np.float32)
+
+
+def _batches(plan, n=40, b=16):
+    rng = np.random.default_rng(3)
+    for _ in range(n):
+        # genuinely learnable: label = argmax of a fixed linear rule
+        img = rng.standard_normal((b, 6, 6, 1)).astype(np.float32)
+        lab = np.argmax(img.reshape(b, -1) @ _W_TRUE, axis=1).astype(np.int32)
+        yield plan.shard_batch({"image": img, "label": lab})
+
+
+def test_compressed_step_trains_and_tracks_exact():
+    plan = ParallelPlan(mesh=_mesh())
+    exact_step = make_train_step(plan=plan)
+    comp_step = make_train_step(plan=plan, grad_compression="int8")
+
+    s_exact = _tiny_state(plan)
+    s_comp = _tiny_state(plan)
+    exact_losses, comp_losses = [], []
+    for batch in _batches(plan):
+        s_exact, m1 = exact_step(s_exact, dict(batch))
+        s_comp, m2 = comp_step(s_comp, dict(batch))
+        exact_losses.append(float(m1["loss_sum"] / m1["count"]))
+        comp_losses.append(float(m2["loss_sum"] / m2["count"]))
+    assert np.isfinite(comp_losses).all()
+    # both learn...
+    assert comp_losses[-1] < comp_losses[0] * 0.7, comp_losses
+    assert exact_losses[-1] < exact_losses[0] * 0.7, exact_losses
+    # ...and the quantized trajectory stays close to the exact one
+    np.testing.assert_allclose(comp_losses, exact_losses, rtol=0.25, atol=0.05)
+    # params stayed finite and in sync (replicated out-spec)
+    for leaf in jax.tree.leaves(s_comp.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_compressed_step_rejects_non_dp_plans():
+    with pytest.raises(ValueError, match="pure-DP"):
+        make_train_step(
+            plan=ParallelPlan(mesh=MeshSpec(data=4, fsdp=2).build(), zero_stage=2),
+            grad_compression="int8",
+        )
+    with pytest.raises(ValueError, match="needs a plan"):
+        make_train_step(grad_compression="int8")
+    with pytest.raises(ValueError, match="unknown grad_compression"):
+        make_train_step(plan=ParallelPlan(mesh=_mesh()), grad_compression="fp8")
+
+
+def test_nonfinite_grads_surface_as_nan():
+    """An inf gradient must propagate (like exact psum) rather than be
+    silently quantized to zeros, so divergence detection still fires."""
+    mesh = _mesh()
+    tree = {"g": jnp.full((8, 4), jnp.inf, jnp.float32)}
+    out = jax.shard_map(
+        lambda t: quantized_pmean(t, ("data",)),
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False,
+    )(tree)
+    assert np.isnan(np.asarray(out["g"])).all()
+
+
+def test_compressed_step_fused_ce_shape():
+    """Per-shard batch divisible by the shard count is the production
+    shape that used to open a nested (crashing) shard_map through the
+    mesh-bound fused-CE loss; it must just work."""
+    plan = ParallelPlan(mesh=_mesh())
+    step = make_train_step(plan=plan, grad_compression="int8")
+    s = _tiny_state(plan)
+    # global 64 over 8 shards -> per-shard 8, divisible by 8
+    batch = next(iter(_batches(plan, n=1, b=64)))
+    s, m = step(s, batch)
+    assert np.isfinite(float(m["loss_sum"]))
+    assert float(m["count"]) == 64.0

@@ -140,7 +140,13 @@ def _report_loop(config):
 
     from tpuframe.launch import report
 
-    report({"rank_sum": float(os.environ["RANK"]) + config["base"]})
+    report(
+        {
+            "rank_sum": float(os.environ["RANK"]) + config["base"],
+            # proves a user-supplied env= actually reached the worker
+            "cred_len": float(len(os.environ.get("MY_CREDENTIAL", ""))),
+        }
+    )
     return "ok"
 
 
@@ -196,6 +202,7 @@ def test_tpu_trainer_hosts_user_env_and_worker_count_guard(tmp_path):
     ).fit()
     assert result.error is None
     assert result.metrics["rank_sum"] == 5.0  # report() still reached the dir
+    assert result.metrics["cred_len"] == 6.0  # "sekret" made it to the worker
 
     with pytest.raises(ValueError, match="num_processes"):
         TPUTrainer(

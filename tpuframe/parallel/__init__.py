@@ -26,6 +26,7 @@ from tpuframe.parallel.pipeline import (
     pipeline_param_spec,
     stack_stage_params,
 )
+from tpuframe.parallel.compression import quantized_pmean
 from tpuframe.parallel.zero import (
     ZeroConfig,
     host_offload_sharding,
@@ -38,6 +39,7 @@ from tpuframe.parallel.zero import (
 )
 
 __all__ = [
+    "quantized_pmean",
     "PipelinedTransformerLM",
     "gpipe_spmd",
     "pipeline_param_spec",
