@@ -1,0 +1,128 @@
+"""Blockwise (flash-style) single-device attention: exactness against
+the full-softmax oracle for outputs AND gradients, block-size edge
+cases, numerical stability at large logits, and TransformerLM wiring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.ops import blockwise_attention
+from tpuframe.ops.ring_attention import attention_reference
+
+
+def _qkv(b=2, l=64, h=4, d=8, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((b, l, h, d)) * scale, jnp.float32
+    )
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_size", [16, 64, 512])
+def test_matches_full_attention(causal, block_size):
+    q, k, v = _qkv()
+    want = attention_reference(q, k, v, causal=causal)
+    got = blockwise_attention(q, k, v, causal=causal, block_size=block_size)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_full(causal):
+    q, k, v = _qkv(l=32)
+
+    def loss_full(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    def loss_blk(q, k, v):
+        return jnp.sum(
+            blockwise_attention(q, k, v, causal=causal, block_size=8) ** 2
+        )
+
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_blk = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_full, g_blk):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=5e-4)
+
+
+@pytest.mark.parametrize("l", [48, 13, 100])
+@pytest.mark.parametrize("causal", [False, True])
+def test_indivisible_lengths_pad_and_mask(l, causal):
+    """Non-multiple (incl. prime) lengths pad up to the block size —
+    padded keys masked, padded query rows sliced — and stay exact."""
+    q, k, v = _qkv(l=l)
+    got = blockwise_attention(q, k, v, causal=causal, block_size=16)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_bf16_inputs_stay_bf16_out():
+    q, k, v = (a.astype(jnp.bfloat16) for a in _qkv(l=32))
+    got = blockwise_attention(q, k, v, causal=True, block_size=8)
+    assert got.dtype == jnp.bfloat16
+    want = attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=0.05
+    )
+
+
+def test_large_logits_no_overflow():
+    # logits ~ +-200: exp() would overflow f32 (max ~exp(88)) without the
+    # running-max subtraction; larger scales make softmax a knife-edge
+    # argmax where fp tie-breaks differ legitimately between schedules
+    q, k, v = _qkv(l=32, scale=8.0)
+    got = blockwise_attention(q, k, v, causal=True, block_size=8)
+    assert np.isfinite(np.asarray(got)).all()
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_mismatched_shapes_rejected():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="must match"):
+        blockwise_attention(q, k[:, :32], v)
+
+
+def test_transformer_lm_blockwise_trains():
+    import optax
+
+    from tpuframe.models import TransformerLM
+    from tpuframe.train import create_train_state, make_train_step
+
+    model = TransformerLM(
+        vocab_size=32, num_layers=2, num_heads=4, head_dim=8, max_len=64,
+        attn_impl="blockwise",
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 32, (8, 64)).astype(np.int32)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.asarray(toks[:1]), optax.adam(1e-3)
+    )
+    step = make_train_step()
+    losses = []
+    for _ in range(5):
+        state, m = step(
+            state,
+            {"input": jnp.asarray(toks), "label": jnp.asarray(np.roll(toks, -1, 1))},
+        )
+        losses.append(float(m["loss_sum"] / m["count"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_unknown_attn_impl_rejected():
+    from tpuframe.models import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=16, num_layers=1, num_heads=2, head_dim=4, max_len=8,
+        attn_impl="flashy",
+    )
+    toks = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, toks, train=False)
+    with pytest.raises(ValueError, match="unknown attn_impl"):
+        model.apply(variables, toks, train=False)

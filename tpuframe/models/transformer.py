@@ -64,8 +64,10 @@ class SelfAttention(nn.Module):
     #: "auto" picks ring attention when the mesh shards the sequence axis
     #: (no head-count constraint); "ulysses" opts into the all-to-all form
     #: (tpuframe.ops.ulysses — one re-shard instead of N-1 ppermute hops,
-    #: needs num_heads divisible by the seq-axis size).
-    attn_impl: str = "auto"  # "auto" | "full" | "ring" | "ulysses"
+    #: needs num_heads divisible by the seq-axis size); "blockwise" is the
+    #: single-shard flash-style O(L*block) path
+    #: (tpuframe.ops.blockwise_attention) for long context on one chip.
+    attn_impl: str = "auto"  # "auto" | "full" | "ring" | "ulysses" | "blockwise"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -114,8 +116,17 @@ class SelfAttention(nn.Module):
                 out_specs=spec,
                 check_vma=False,
             )(q, k, v)
-        else:
+        elif impl == "blockwise":
+            from tpuframe.ops.blockwise_attention import blockwise_attention
+
+            out = blockwise_attention(q, k, v, causal=self.causal)
+        elif impl == "full":
             out = attention_reference(q, k, v, causal=self.causal)
+        else:
+            raise ValueError(
+                f"unknown attn_impl {impl!r}; known: auto, full, ring, "
+                "ulysses, blockwise"
+            )
         out = out.reshape(b, l, features)
         return nn.Dense(
             x.shape[-1], use_bias=False, dtype=self.dtype, name="attn_out"

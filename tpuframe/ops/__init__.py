@@ -31,6 +31,7 @@ from tpuframe.ops.layer_norm import (
     fused_layer_norm,
     layer_norm_reference,
 )
+from tpuframe.ops.blockwise_attention import blockwise_attention
 from tpuframe.ops.ulysses import ulysses_attention, ulysses_attention_local
 from tpuframe.ops.ring_attention import (
     attention_reference,
@@ -39,6 +40,7 @@ from tpuframe.ops.ring_attention import (
 )
 
 __all__ = [
+    "blockwise_attention",
     "attention_reference",
     "ring_attention",
     "ring_attention_local",

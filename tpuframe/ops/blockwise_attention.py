@@ -1,0 +1,106 @@
+"""Blockwise (flash-style) single-device attention: linear-in-L memory.
+
+Ring attention (`tpuframe.ops.ring_attention`) spreads the sequence over
+chips; this is the within-one-shard counterpart for long context that
+FITS on a chip but whose (B, H, L, L) score matrix would not — forward
+AND backward:
+
+- the outer ``lax.scan`` walks Q blocks with **no carry**, so reverse
+  mode saves only each step's small inputs (one Q block), never an
+  O(L)-sized accumulator per step;
+- each Q-block body is ``jax.checkpoint``'d and runs the inner online-
+  softmax K/V scan (`ring_attention._block_update` — one numerics
+  implementation, ring and blockwise schedules share it); its backward
+  recomputes the K/V sweep for that Q block, the flash-attention
+  recipe, with peak residency O(B·L·H·D) + one (block × block) score
+  tile;
+- K/V keep their storage dtype (bf16) outside the body and upcast one
+  block at a time inside it;
+- L pads up to a block multiple (padded keys are masked via ``kv_len``,
+  padded query rows are sliced off) — one MXU-friendly compiled
+  schedule for any L, never a degenerate tiny-block divisor.
+
+Causal note: blocks entirely above the diagonal are masked, not
+skipped — static shapes buy XLA one schedule at the price of ~2x FLOPs
+on the causal half; the op's job is memory, not FLOP avoidance.
+
+``TransformerLM(attn_impl="blockwise")`` selects it; composes with the
+``seq``-sharded impls (they shard ACROSS devices, this blocks WITHIN
+one).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuframe.ops.ring_attention import _block_update
+
+__all__ = ["blockwise_attention"]
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_size: int = 512,
+) -> jax.Array:
+    """Exact attention over (B, L, H, D) without materializing (.., L, L)."""
+    b, l, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
+        )
+    block = min(block_size, l)
+    n = -(-l // block)
+    l_pad = n * block
+    if l_pad != l:
+        pad = [(0, 0), (0, l_pad - l), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+    scale = 1.0 / math.sqrt(d)
+
+    # (n, B, block, H, D): scans walk the leading axis.  Storage dtype is
+    # kept — one block upcasts to f32 at a time inside the body.
+    to_blocks = lambda a: a.reshape(b, n, block, h, d).transpose(1, 0, 2, 3, 4)  # noqa: E731
+    q_blocks, k_blocks, v_blocks = to_blocks(q), to_blocks(k), to_blocks(v)
+    block_pos = jnp.arange(block)
+
+    @jax.checkpoint
+    def q_body(q_blk, q_idx):
+        q_pos = q_idx * block + block_pos
+        init = (
+            jnp.zeros((b, block, h, d), jnp.float32),
+            jnp.zeros((b, h, block), jnp.float32),
+            jnp.full((b, h, block), -jnp.inf, jnp.float32),
+        )
+
+        def kv_body(carry, blk):
+            o, lsum, m = carry
+            k_blk, v_blk, k_idx = blk
+            o, lsum, m = _block_update(
+                q_blk.astype(jnp.float32),
+                k_blk.astype(jnp.float32),
+                v_blk.astype(jnp.float32),
+                o, lsum, m,
+                q_pos, k_idx * block + block_pos,
+                causal, scale, kv_len=l,
+            )
+            return (o, lsum, m), None
+
+        (o, lsum, _), _ = lax.scan(
+            kv_body, init, (k_blocks, v_blocks, jnp.arange(n))
+        )
+        lsum = jnp.maximum(lsum, 1e-30)  # fully-masked (padded/causal) rows
+        return o / lsum.transpose(0, 2, 1)[..., None]
+
+    # carrier-less outer scan: ys-only, nothing O(L) saved per step
+    _, outs = lax.scan(
+        lambda _, xs: (None, q_body(*xs)), None, (q_blocks, jnp.arange(n))
+    )
+    out = outs.transpose(1, 0, 2, 3, 4).reshape(b, l_pad, h, d)[:, :l]
+    return out.astype(q.dtype)

@@ -46,12 +46,19 @@ def attention_reference(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_update(q, k, v, o, l, m, q_pos, k_pos, causal, scale):
-    """Online-softmax accumulation of one K/V block into (o, l, m)."""
+def _block_update(q, k, v, o, l, m, q_pos, k_pos, causal, scale,
+                  kv_len: int | None = None):
+    """Online-softmax accumulation of one K/V block into (o, l, m).
+
+    ``kv_len`` masks padded key positions (``k_pos >= kv_len``) — used by
+    the blockwise schedule, which pads the sequence to a block multiple.
+    """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # (B, H, Lq, Lk)
     if causal:
         mask = k_pos[None, :] <= q_pos[:, None]  # (Lq, Lk)
         s = jnp.where(mask[None, None], s, -jnp.inf)
+    if kv_len is not None:
+        s = jnp.where((k_pos < kv_len)[None, None, None, :], s, -jnp.inf)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # (B, H, Lq)
     # exp(-inf - m) -> 0 handles fully-masked rows; keep m finite
     m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
