@@ -11,6 +11,8 @@ sharded over the mesh's ``seq`` axis:
 - ``--attn ulysses``  all-to-all head<->sequence re-sharding (DeepSpeed-
                       Ulysses pattern; needs heads % seq_shards == 0);
 - ``--attn full``     no SP, the single-chip baseline.
+- ``--attn blockwise`` no SP, flash-style O(L·block)-memory single-shard
+  path for long context that fits one chip (tpuframe.ops.blockwise_attention).
 
 Composable with the rest of the ladder: ZeRO via ``--zero-stage`` shards
 optimizer state over the fsdp axis; ``--moe-experts N`` swaps every
@@ -129,7 +131,7 @@ def train(args) -> dict:
 def main(argv=None):
     p = base_parser("Long-context LM with ring/Ulysses sequence parallelism")
     p.add_argument("--attn", default="ring",
-                   choices=["ring", "ulysses", "full", "auto"])
+                   choices=["ring", "ulysses", "full", "auto", "blockwise"])
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--seq-shards", type=int, default=4)
     p.add_argument("--vocab", type=int, default=256)
