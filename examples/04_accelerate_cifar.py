@@ -37,7 +37,13 @@ from tpuframe.train import (
 
 
 def main(argv=None):
-    args = base_parser(__doc__).parse_args(argv)
+    p = base_parser(__doc__)
+    p.add_argument(
+        "--grad-compression", default=None, choices=["int8"],
+        help="int8-quantized gradient all-reduce (DCN-bound DP; "
+        "tpuframe.parallel.compression); omit for the exact all-reduce",
+    )
+    args = p.parse_args(argv)
     rt = core.initialize()
     plan = ParallelPlan(mesh=rt.mesh)  # ≈ accelerator.prepare
 
@@ -54,7 +60,9 @@ def main(argv=None):
         jnp.ones((1, args.image_size, args.image_size, 3)),
         optax.adam(schedule), plan=plan, init_kwargs={"train": False},
     )
-    train_step = make_train_step()
+    train_step = make_train_step(
+        plan=plan, grad_compression=args.grad_compression
+    )
     eval_step = make_eval_step()
 
     logger = MLflowLogger(
