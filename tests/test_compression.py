@@ -147,3 +147,36 @@ def test_compressed_step_fused_ce_shape():
     s, m = step(s, batch)
     assert np.isfinite(float(m["loss_sum"]))
     assert float(m["count"]) == 64.0
+
+
+def test_compressed_step_with_mesh_reading_kernels(monkeypatch):
+    """Mesh-reading fused ops (FusedLayerNorm inside TransformerLM) must
+    NOT nest a second shard_map inside the compressed step — the
+    inside_shard_map dispatch guard runs them per-shard instead.
+    Regression: this crashed with 'context mesh should match' when the
+    runtime mesh was initialized and kernels engaged (interpret/TPU)."""
+    monkeypatch.setenv("TPUFRAME_PALLAS_INTERPRET", "1")
+
+    from tpuframe.core import runtime as rt
+    from tpuframe.models import TransformerLM
+
+    rt.reset_runtime()
+    try:
+        rt.initialize({"data": -1})
+        plan = ParallelPlan(mesh=rt.current_runtime().mesh)
+        lm = TransformerLM(
+            vocab_size=32, num_layers=1, num_heads=2, head_dim=8, max_len=16,
+            attn_impl="blockwise",
+        )
+        toks = np.random.default_rng(0).integers(0, 32, (16, 8)).astype(np.int32)
+        state = create_train_state(
+            lm, jax.random.PRNGKey(0), jnp.asarray(toks[:1]), optax.adam(1e-3),
+            plan=plan,
+        )
+        step = make_train_step(plan=plan, grad_compression="int8")
+        state, m = step(
+            state, plan.shard_batch({"input": toks, "label": np.roll(toks, -1, 1)})
+        )
+        assert np.isfinite(float(m["loss_sum"]))
+    finally:
+        rt.reset_runtime()

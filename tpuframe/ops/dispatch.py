@@ -55,22 +55,47 @@ def use_pallas() -> bool:
     return mode == "interpret" or jax.device_count() == 1
 
 
+def inside_shard_map() -> bool:
+    """True when tracing inside an existing shard_map/manual region.
+
+    Nesting a second ``shard_map`` there crashes ("context mesh should
+    match"); but a bare kernel call IS the per-shard invocation already,
+    so ops should drop their mesh and engage directly.  This is what
+    lets mesh-reading modules (FusedLayerNorm inside a TransformerLM)
+    compose with shard_map-based steps like
+    ``make_train_step(grad_compression=...)``.
+    """
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        return any(
+            "Manual" in str(t) for t in getattr(am, "axis_types", ()) or ()
+        )
+    except Exception:
+        return False
+
+
 def resolve_interpret(interpret: bool | None, shardable: bool) -> bool | None:
     """Shared op-level engage decision.
 
     Returns the interpret flag to use, or None meaning "run the jnp
     reference path".  An explicit ``interpret`` always wins.  Auto mode
     engages the kernel when the backend compiles it (TPU) and either the
-    process is single-device or the caller can invoke it per-shard under
-    ``shard_map`` (``shardable``) — a bare pallas custom call inside a
-    multi-device jit would force operand replication.
+    process is single-device, the caller can invoke it per-shard under
+    ``shard_map`` (``shardable``), or we are ALREADY per-shard inside a
+    manual region — a bare pallas custom call inside a plain multi-device
+    jit is the one placement that would force operand replication.
     """
     if interpret is not None:
         return interpret
     mode = pallas_mode()
     if mode is None:
         return None
-    if mode == "compiled" and jax.device_count() > 1 and not shardable:
+    if (
+        mode == "compiled"
+        and jax.device_count() > 1
+        and not shardable
+        and not inside_shard_map()
+    ):
         return None
     return mode == "interpret"
 
@@ -82,7 +107,9 @@ def batch_sharding_info(mesh, batch_axes, leading_size: int):
         from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS
 
         batch_axes = (DATA_AXIS, FSDP_AXIS)
-    if mesh is None:
+    if mesh is None or inside_shard_map():
+        # inside a manual region the caller's mesh is already consumed —
+        # report unshardable so the op runs its bare per-shard form
         return (), 1, False
     axes = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
     n = 1

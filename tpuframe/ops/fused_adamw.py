@@ -111,6 +111,13 @@ def fused_adamw_update(
     """
     hp = dict(lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
 
+    from tpuframe.ops.dispatch import inside_shard_map
+
+    if inside_shard_map():
+        # already per-shard (a shard_map-based train step): a nested
+        # shard_map would crash, and the bare kernel is the shard body
+        mesh, shard_axis = None, None
+
     shape, dtype = p.shape, p.dtype
     n = p.size
     # Lane-aligned leaves skip the host-side pad copy; Pallas clips the
