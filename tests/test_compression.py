@@ -180,3 +180,40 @@ def test_compressed_step_with_mesh_reading_kernels(monkeypatch):
         assert np.isfinite(float(m["loss_sum"]))
     finally:
         rt.reset_runtime()
+
+
+def test_trainer_grad_compression_plumbs_through():
+    from flax import linen as nn
+
+    from tpuframe.data import DataLoader, SyntheticImageDataset
+    from tpuframe.train import Trainer
+
+    ds = SyntheticImageDataset(n=32, image_size=8, num_classes=4, seed=0)
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    trainer = Trainer(
+        Tiny(),
+        train_dataloader=DataLoader(ds, batch_size=8, shuffle=True, seed=0),
+        max_duration="2ep",
+        optimizer="adam",
+        lr=1e-2,
+        num_classes=4,
+        grad_compression="int8",
+        eval_interval=0,
+        log_interval=0,
+    )
+    result = trainer.fit()
+    assert np.isfinite(result.metrics["train_loss"])
+
+    with pytest.raises(ValueError, match="does not compose"):
+        Trainer(
+            Tiny(),
+            train_dataloader=DataLoader(ds, batch_size=8),
+            grad_accum=2,
+            grad_compression="int8",
+            num_classes=4,
+        )

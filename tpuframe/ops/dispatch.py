@@ -67,11 +67,19 @@ def inside_shard_map() -> bool:
     """
     try:
         am = jax.sharding.get_abstract_mesh()
-        return any(
-            "Manual" in str(t) for t in getattr(am, "axis_types", ()) or ()
-        )
-    except Exception:
+        manual = jax.sharding.AxisType.Manual
+        return manual in (getattr(am, "axis_types", ()) or ())
+    except AttributeError:  # much older jax: no abstract-mesh API
         return False
+
+
+def effective_mesh(mesh):
+    """The mesh an op should actually shard over: ``None`` inside a
+    manual region (the caller's shard_map already consumed it — run the
+    bare per-shard form), the given mesh otherwise.  Every mesh-taking
+    op routes its mesh through here so the no-nesting invariant is
+    structural, not per-op boilerplate."""
+    return None if inside_shard_map() else mesh
 
 
 def resolve_interpret(interpret: bool | None, shardable: bool) -> bool | None:
@@ -107,9 +115,8 @@ def batch_sharding_info(mesh, batch_axes, leading_size: int):
         from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS
 
         batch_axes = (DATA_AXIS, FSDP_AXIS)
-    if mesh is None or inside_shard_map():
-        # inside a manual region the caller's mesh is already consumed —
-        # report unshardable so the op runs its bare per-shard form
+    mesh = effective_mesh(mesh)
+    if mesh is None:
         return (), 1, False
     axes = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
     n = 1

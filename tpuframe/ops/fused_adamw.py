@@ -111,12 +111,9 @@ def fused_adamw_update(
     """
     hp = dict(lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
 
-    from tpuframe.ops.dispatch import inside_shard_map
+    from tpuframe.ops.dispatch import effective_mesh
 
-    if inside_shard_map():
-        # already per-shard (a shard_map-based train step): a nested
-        # shard_map would crash, and the bare kernel is the shard body
-        mesh, shard_axis = None, None
+    mesh = effective_mesh(mesh)
 
     shape, dtype = p.shape, p.dtype
     n = p.size

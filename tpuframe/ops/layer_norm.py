@@ -200,12 +200,9 @@ def fused_layer_norm(
             f"scale/bias shapes {scale.shape}/{bias.shape} != (.., {x.shape[-1]})"
         )
     lead = x.shape[:-1]
-    from tpuframe.ops.dispatch import inside_shard_map
+    from tpuframe.ops.dispatch import effective_mesh
 
-    if inside_shard_map():
-        # already per-shard (e.g. a compressed train step's shard_map):
-        # a nested shard_map would crash; the bare kernel IS the shard body
-        mesh = None
+    mesh = effective_mesh(mesh)
     if spec is not None and mesh is not None:
         full = tuple(spec) + (None,) * (x.ndim - len(tuple(spec)))
         if full[-1] is not None:

@@ -121,6 +121,7 @@ class Trainer:
         report: Callable[[dict, str | None], None] | None = None,
         grad_accum: int = 1,
         grad_clip: float | None = None,
+        grad_compression: str | None = None,
         normalize: tuple | None = None,
     ):
         if precision is None:
@@ -235,6 +236,11 @@ class Trainer:
             # DeepSpeed's gradient_accumulation_steps
             # (`deepspeed_config.py:17`): host batches are reshaped to
             # (n_micro, micro, ...) in _device_batches.
+            if grad_compression is not None:
+                raise ValueError(
+                    "grad_compression does not compose with grad_accum yet; "
+                    "pick one"
+                )
             self._train_step = make_grad_accum_step(
                 grad_accum, self.policy, loss_fn, plan=self.plan,
                 batch_transform=train_transform,
@@ -243,6 +249,7 @@ class Trainer:
             self._train_step = make_train_step(
                 self.policy, loss_fn, plan=self.plan,
                 batch_transform=train_transform,
+                grad_compression=grad_compression,
             )
         self._eval_step = make_eval_step(
             self.policy, loss_fn, plan=self.plan, batch_transform=eval_transform
