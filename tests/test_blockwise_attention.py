@@ -126,3 +126,51 @@ def test_unknown_attn_impl_rejected():
     variables = model.init({"params": jax.random.PRNGKey(0)}, toks, train=False)
     with pytest.raises(ValueError, match="unknown attn_impl"):
         model.apply(variables, toks, train=False)
+
+
+def test_auto_picks_blockwise_for_long_unsharded_seq(monkeypatch):
+    """attn_impl='auto' must route long single-shard sequences through the
+    linear-memory path instead of materializing (B,H,L,L)."""
+    from tpuframe.core import runtime as rt
+
+    rt.reset_runtime()  # a leaked seq-sharded mesh would dispatch to ring
+    import tpuframe.models.transformer as tr
+
+    calls = []
+    real = tr.attention_reference
+
+    def spy_full(q, k, v, causal=False):
+        calls.append("full")
+        return real(q, k, v, causal=causal)
+
+    # `tpuframe.ops.blockwise_attention` the attribute is the FUNCTION
+    # (ops/__init__ rebinds the name); fetch the module itself
+    import importlib
+
+    bw = importlib.import_module("tpuframe.ops.blockwise_attention")
+    real_blk = bw.blockwise_attention
+
+    def spy_blk(q, k, v, **kw):
+        calls.append("blockwise")
+        return real_blk(q, k, v, **kw)
+
+    monkeypatch.setattr(tr, "attention_reference", spy_full)
+    monkeypatch.setattr(bw, "blockwise_attention", spy_blk)
+    monkeypatch.setattr(tr, "_BLOCKWISE_AUTO_LEN", 64)  # keep the test small
+
+    from tpuframe.models import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=16, num_layers=1, num_heads=2, head_dim=4, max_len=128,
+        attn_impl="auto",
+    )
+    long_toks = jnp.zeros((1, 128), jnp.int32)
+    short_toks = jnp.zeros((1, 16), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, short_toks,
+                           train=False)
+    calls.clear()
+    model.apply(variables, long_toks, train=False)
+    assert "blockwise" in calls and "full" not in calls
+    calls.clear()
+    model.apply(variables, short_toks, train=False)
+    assert "full" in calls and "blockwise" not in calls

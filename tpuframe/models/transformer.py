@@ -8,7 +8,9 @@ family is the workload that exercises the ``seq`` mesh axis.  Design:
 - Attention dispatch: ``attn_impl="auto"`` uses exact ring attention
   (`tpuframe.ops.ring_attention`) whenever the current mesh shards the
   sequence axis — K/V rotate the ICI ring, scores never materialize
-  globally — and plain XLA attention otherwise.
+  globally; unsharded sequences of ``_BLOCKWISE_AUTO_LEN`` (4k) tokens
+  or more take the flash-style linear-memory blockwise path; short
+  unsharded sequences use plain XLA attention.
 - Tensor-parallel ready: :func:`transformer_tp_rules` gives the
   ParallelPlan rules that split QKV/MLP projections over ``model``
   (Megatron-style column->row pairing; XLA inserts the all-reduces).
@@ -33,6 +35,11 @@ from tpuframe.core.runtime import (
 from tpuframe.ops.ring_attention import attention_reference, ring_attention_local
 from tpuframe.ops.layer_norm import FusedLayerNorm
 from tpuframe.ops.ulysses import ulysses_attention_local
+
+#: attn_impl="auto" switches full -> blockwise at this unsharded sequence
+#: length: 4k tokens is a 64 MB f32 score matrix PER (batch, head) — the
+#: materialization, not the FLOPs, starts to dominate HBM there.
+_BLOCKWISE_AUTO_LEN = 4096
 
 
 def transformer_tp_rules():
@@ -62,7 +69,9 @@ class SelfAttention(nn.Module):
     head_dim: int
     causal: bool = True
     #: "auto" picks ring attention when the mesh shards the sequence axis
-    #: (no head-count constraint); "ulysses" opts into the all-to-all form
+    #: (no head-count constraint), blockwise for unsharded sequences of
+    #: _BLOCKWISE_AUTO_LEN+ tokens, full otherwise; "ulysses" opts into
+    #: the all-to-all form
     #: (tpuframe.ops.ulysses — one re-shard instead of N-1 ppermute hops,
     #: needs num_heads divisible by the seq-axis size); "blockwise" is the
     #: single-shard flash-style O(L*block) path
@@ -91,7 +100,14 @@ class SelfAttention(nn.Module):
             impl = "full"
         elif impl == "auto":
             seq_sharded = mesh is not None and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
-            impl = "ring" if seq_sharded else "full"
+            if seq_sharded:
+                impl = "ring"
+            elif l >= _BLOCKWISE_AUTO_LEN:
+                # long unsharded context: the (B,H,L,L) score matrix is the
+                # memory hazard; take the flash-style linear-memory path
+                impl = "blockwise"
+            else:
+                impl = "full"
         if impl in ("ring", "ulysses"):
             if mesh is None:
                 raise ValueError(
