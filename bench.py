@@ -79,12 +79,16 @@ def time_train_step(compiled, state, data, *, batch: int, steps: int,
                     rounds: int = 3):
     """Median images/sec over ``rounds`` timed windows of ``steps`` steps.
 
-    Warms up twice, blocks on the FULL output pytree each round (guards
-    against async-dispatch artifacts where blocking on one small output
-    under-reports wall time), and asserts the step counter really
-    advanced.  Returns ``(images_per_sec, final_state, final_metrics)``.
-    The one timing methodology for bench.py and the perf-experiment
-    harness — fixes here reach both.
+    Warms up twice, then ends every timed window with a *value readback*
+    of the step counter — on a remote-dispatch backend (the axon tunnel)
+    ``block_until_ready`` alone is not a reliable execution barrier for
+    unchained programs (measured: 0.07 ms/"step" for a 412-GFLOP
+    attention — pure dispatch), while a scalar readback is.  The donated
+    state chain paces the loop to real execution, so the single readback
+    RPC (~60 ms) is the only overhead inside the window; it amortizes
+    over ``steps``.  Returns ``(images_per_sec, final_state,
+    final_metrics)``.  The one timing methodology for bench.py and the
+    perf-experiment harness — fixes here reach both.
     """
     import jax
     import numpy as np
@@ -92,15 +96,18 @@ def time_train_step(compiled, state, data, *, batch: int, steps: int,
     for _ in range(2):
         state, metrics = compiled(state, data)
     jax.block_until_ready((state, metrics))
+    _ = int(state.step)
     rates = []
     for _ in range(rounds):
         step_before = int(state.step)
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = compiled(state, data)
-        jax.block_until_ready((state, metrics))
+        # the readback IS the sync barrier — inside the timed window so
+        # the recorded rate never counts un-executed dispatches
+        step_now = int(state.step)
         elapsed = time.perf_counter() - t0
-        assert int(state.step) == step_before + steps
+        assert step_now == step_before + steps
         rates.append(batch * steps / elapsed)
     assert np.isfinite(float(metrics["loss_sum"]))
     return sorted(rates)[len(rates) // 2], state, metrics
@@ -137,7 +144,7 @@ def _run_bench() -> None:
     chips = max(jax.local_device_count(), 1)
     batch = 128 * chips if on_accel else 8
     size = 224 if on_accel else 32
-    steps = 30 if on_accel else 3
+    steps = 60 if on_accel else 3
 
     # Data-parallel over every local device so the per-chip division below
     # reflects work actually placed on each chip.
@@ -146,8 +153,17 @@ def _run_bench() -> None:
     policy = bf16_compute() if on_accel else full_precision()
     # Model compute dtype must match the policy: an f32 model under a bf16
     # policy silently up-casts inside every layer, and the HBM-bound step
-    # pays double traffic (measured: 1.4k vs 2.3k img/s on v5e).
-    model = align_model_dtype(ResNet50(num_classes=1000), policy)
+    # pays double traffic (measured: 1.4k vs 2.3k img/s on v5e).  BN
+    # outputs in bf16 (running stats stay f32) cut the f32 BN→relu→conv
+    # activation traffic: 2248 → 2423 img/s in the r03 A/B
+    # (benchmarks/bench_tpu_experiments.py, PERF.md).
+    model = align_model_dtype(
+        ResNet50(
+            num_classes=1000,
+            norm_dtype=jnp.bfloat16 if on_accel else None,
+        ),
+        policy,
+    )
     tx = optax.sgd(0.1, momentum=0.9)
     state = create_train_state(
         model,

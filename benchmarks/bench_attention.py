@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Isolate long-context attention on the chip: impl x block-size sweep.
+
+Times forward and forward+backward of the attention op alone
+(B=2, H=12, D=64, bf16) at a given sequence length, for:
+
+  full            XLA attention (materializes the (L, L) scores) — the
+                  speed ceiling while memory lasts
+  blockwise_<N>   tpuframe.ops.blockwise_attention with block_size=N
+
+Prints one JSON line per variant: ms/step fwd and fwd+bwd, achieved
+TFLOP/s vs the analytic attention FLOPs (4*B*H*L^2*D fwd, x2.5 bwd).
+Used to pick the default block size and to quantify the gap a Pallas
+flash kernel would need to close (PERF.md).
+
+Usage: python benchmarks/bench_attention.py [--seq 8192] [--blocks 512,1024,2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+B, H, D = 2, 12, 64
+
+
+def _time(fn, q, k, v, steps=10, *, chain):
+    """ms/step with honest pacing on a remote-dispatch backend.
+
+    ``block_until_ready`` alone is NOT a sync barrier on the axon tunnel
+    (measured: 0.07 ms/"step" for a 412-GFLOP attention — pure dispatch).
+    Chain each call's outputs into the next call's inputs so execution
+    serializes, and force one scalar readback inside the timed window;
+    the single RPC (~60 ms) amortizes over ``steps``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out = fn(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(q, k, v)
+        q, k, v = chain(out, q, k, v)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0][0, 0]))  # real sync
+    return (time.perf_counter() - t0) / steps * 1000.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--blocks", default="512,1024,2048")
+    ap.add_argument("--skip-full", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tpuframe_xla_cache")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+        )
+    except Exception:
+        pass
+
+    from tpuframe.ops.blockwise_attention import blockwise_attention
+    from tpuframe.ops.ring_attention import attention_reference
+
+    L = args.seq
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, L, H, D)) * 0.1, jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+
+    # analytic attention FLOPs (two matmuls, causal half not skipped)
+    fwd_flops = 4 * B * H * L * L * D
+    variants: list[tuple[str, object]] = []
+    if not args.skip_full:
+        variants.append(("full", functools.partial(attention_reference, causal=True)))
+    for blk in (int(x) for x in args.blocks.split(",")):
+        variants.append(
+            (
+                f"blockwise_{blk}",
+                functools.partial(blockwise_attention, causal=True, block_size=blk),
+            )
+        )
+
+    for name, fn in variants:
+        fwd = jax.jit(fn)
+
+        def loss(q, k, v, _fn=fn):
+            return jnp.sum(_fn(q, k, v).astype(jnp.float32) ** 2)
+
+        fwdbwd = jax.jit(jax.grad(loss, (0, 1, 2)))
+        # chain outputs -> inputs so the remote backend can't overlap
+        # steps (see _time); grads chain positionally
+        t_fwd = _time(fwd, q, k, v, chain=lambda out, q, k, v: (out, k, v))
+        t_bwd = _time(fwdbwd, q, k, v, chain=lambda out, q, k, v: out)
+        print(
+            json.dumps(
+                {
+                    "variant": name,
+                    "seq": L,
+                    "fwd_ms": round(t_fwd, 2),
+                    "fwdbwd_ms": round(t_bwd, 2),
+                    "fwd_tflops": round(fwd_flops / t_fwd / 1e9, 1),
+                    "fwdbwd_tflops": round(3.5 * fwd_flops / t_bwd / 1e9, 1),
+                    "backend": jax.default_backend(),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

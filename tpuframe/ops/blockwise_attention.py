@@ -14,8 +14,9 @@ AND backward:
   recomputes the K/V sweep for that Q block, the flash-attention
   recipe, with peak residency O(B·L·H·D) + one (block × block) score
   tile;
-- K/V keep their storage dtype (bf16) outside the body and upcast one
-  block at a time inside it;
+- Q/K/V keep their storage dtype end to end: the MXU multiplies bf16
+  natively with f32 accumulation (see ``_block_update``), only the
+  online-softmax state is f32;
 - L pads up to a block multiple (padded keys are masked via ``kv_len``,
   padded query rows are sliced off) — one MXU-friendly compiled
   schedule for any L, never a degenerate tiny-block divisor.
@@ -64,8 +65,8 @@ def blockwise_attention(
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
     scale = 1.0 / math.sqrt(d)
 
-    # (n, B, block, H, D): scans walk the leading axis.  Storage dtype is
-    # kept — one block upcasts to f32 at a time inside the body.
+    # (n, B, block, H, D): scans walk the leading axis.  Storage dtype
+    # (bf16) feeds the MXU directly; only softmax state is f32.
     to_blocks = lambda a: a.reshape(b, n, block, h, d).transpose(1, 0, 2, 3, 4)  # noqa: E731
     q_blocks, k_blocks, v_blocks = to_blocks(q), to_blocks(k), to_blocks(v)
     block_pos = jnp.arange(block)
@@ -83,9 +84,7 @@ def blockwise_attention(
             o, lsum, m = carry
             k_blk, v_blk, k_idx = blk
             o, lsum, m = _block_update(
-                q_blk.astype(jnp.float32),
-                k_blk.astype(jnp.float32),
-                v_blk.astype(jnp.float32),
+                q_blk, k_blk, v_blk,
                 o, lsum, m,
                 q_pos, k_idx * block + block_pos,
                 causal, scale, kv_len=l,

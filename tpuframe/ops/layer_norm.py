@@ -77,8 +77,17 @@ def _bwd_kernel(x_ref, scale_ref, g_ref, dx_ref, dscale_ref, dbias_ref, *, d, ep
     dx = rstd * (gs - m1 - xhat * m2)
     dx_ref[...] = jnp.where(valid, dx, 0.0).astype(dx_ref.dtype)
     gv = jnp.where(valid, g, 0.0)
-    dscale_ref[...] = jnp.sum(gv * xhat, 0, keepdims=True)
-    dbias_ref[...] = jnp.sum(gv, 0, keepdims=True)
+    # Affine-grad partials accumulate into ONE (_ROWS, dp) block revisited
+    # by every grid step (the sequential-grid accumulation pattern): a
+    # per-step (1, dp) output block would violate Mosaic's (8, 128) tile
+    # minimum whenever the grid has >1 step.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dscale_ref[...] = jnp.zeros_like(dscale_ref)
+        dbias_ref[...] = jnp.zeros_like(dbias_ref)
+
+    dscale_ref[...] += gv * xhat
+    dbias_ref[...] += gv
 
 
 def _pad_rows(x):
@@ -118,8 +127,8 @@ def _bwd_pallas(x, scale, g, eps, interpret):
         functools.partial(_bwd_kernel, d=d, eps=eps),
         out_shape=(
             jax.ShapeDtypeStruct((np_, dp), x.dtype),
-            jax.ShapeDtypeStruct((blocks, dp), jnp.float32),
-            jax.ShapeDtypeStruct((blocks, dp), jnp.float32),
+            jax.ShapeDtypeStruct((_ROWS, dp), jnp.float32),
+            jax.ShapeDtypeStruct((_ROWS, dp), jnp.float32),
         ),
         grid=(blocks,),
         in_specs=[
@@ -129,8 +138,8 @@ def _bwd_pallas(x, scale, g, eps, interpret):
         ],
         out_specs=(
             pl.BlockSpec((_ROWS, dp), lambda i: (i, 0)),
-            pl.BlockSpec((1, dp), lambda i: (i, 0)),
-            pl.BlockSpec((1, dp), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS, dp), lambda i: (0, 0)),
+            pl.BlockSpec((_ROWS, dp), lambda i: (0, 0)),
         ),
         interpret=interpret,
     )(xp, sp, gp)
