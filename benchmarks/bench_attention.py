@@ -43,7 +43,10 @@ def _time(fn, q, k, v, steps=10, *, chain):
     import jax.numpy as jnp
 
     out = fn(q, k, v)
-    jax.block_until_ready(out)
+    # drain with a readback, not block_until_ready: the warmup (and, for
+    # the first variant, device first-touch init) must not leak into the
+    # timed window
+    _ = float(jnp.sum(jax.tree.leaves(out)[0][0, 0]))
     t0 = time.perf_counter()
     for _ in range(steps):
         out = fn(q, k, v)

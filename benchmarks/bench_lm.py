@@ -65,8 +65,11 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
         policy,
     )
     plan = ParallelPlan(mesh=MeshSpec(data=-1).build())
+    # config batch is per chip; the data mesh spans every local device and
+    # shard_batch requires divisibility (bench.py scales the same way)
+    batch_size = cfg["batch"] * max(jax.local_device_count(), 1)
     rng = np.random.default_rng(0)
-    tokens = rng.integers(0, VOCAB, (cfg["batch"], cfg["seq"])).astype(np.int32)
+    tokens = rng.integers(0, VOCAB, (batch_size, cfg["seq"])).astype(np.int32)
     state = create_train_state(
         model,
         jax.random.PRNGKey(0),
@@ -81,7 +84,7 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
     compiled = make_train_step(policy).lower(state, batch).compile()
     flops, bytes_accessed = headline_bench.cost_analysis(compiled)
     img_s, state, _metrics = headline_bench.time_train_step(
-        compiled, state, batch, batch=cfg["batch"], steps=steps
+        compiled, state, batch, batch=batch_size, steps=steps
     )
     tokens_s = img_s * cfg["seq"]
     backend = jax.default_backend()
@@ -91,7 +94,7 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
     return {
         "config": name,
         "seq_len": cfg["seq"],
-        "batch": cfg["batch"],
+        "batch": batch_size,
         "params_m": round(n_params / 1e6, 1),
         "backend": backend,
         "device_kind": device_kind,
@@ -100,12 +103,12 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
         # remat recompute, so the long_remat row reports hardware
         # utilization, not "useful-FLOP" MFU)
         "mfu": (
-            round(flops * img_s / cfg["batch"] / peak, 4)
+            round(flops * img_s / batch_size / peak, 4)
             if flops and peak
             else None
         ),
         "hbm_gb_per_step": round(bytes_accessed / 1e9, 2) if bytes_accessed else None,
-        "step_ms": round(cfg["batch"] / img_s * 1000, 2),
+        "step_ms": round(batch_size / img_s * 1000, 2),
     }
 
 
