@@ -52,6 +52,23 @@ _PEAK_BF16 = [
 ]
 
 
+def enable_compile_cache(default_dir: str = "/tmp/tpuframe_xla_cache") -> None:
+    """Point JAX at the persistent compile cache (idempotent).
+
+    One shared helper for bench.py and every benchmarks/ script so the
+    cache path and knobs can't drift between them; safe on jax versions
+    without the config knobs (cache is an optimization only).
+    """
+    cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", default_dir)
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
 def _peak_flops(device_kind: str) -> float | None:
     kind = device_kind.lower()
     for key, peak in _PEAK_BF16:
@@ -118,13 +135,7 @@ def _run_bench() -> None:
 
     # Persistent compiled-program cache: a bench retry after a recovered
     # backend (or a rerun in the same session) skips recompilation.
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
-    if cache_dir:
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass  # older jax without the knobs: cache is an optimization only
+    enable_compile_cache()
 
     import jax.numpy as jnp
     import numpy as np

@@ -62,17 +62,19 @@ def main() -> None:
     ap.add_argument("--skip-full", action="store_true")
     args = ap.parse_args()
 
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tpuframe_xla_cache")
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
-        )
-    except Exception:
-        pass
+    import bench as headline_bench
+
+    headline_bench.enable_compile_cache()
+    # fail fast with a diagnostic if the backend is wedged (a hung
+    # remote-compile helper would otherwise hang the first jit forever)
+    verdict, detail = headline_bench._preflight(dict(os.environ), 180.0)
+    if verdict != "ok":
+        print(json.dumps({"error": f"backend preflight {verdict}: {detail}"}))
+        raise SystemExit(1)
 
     from tpuframe.ops.blockwise_attention import blockwise_attention
     from tpuframe.ops.ring_attention import attention_reference

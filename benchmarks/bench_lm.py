@@ -118,16 +118,11 @@ def main() -> None:
     ap.add_argument("--configs", default="gpt_small,long_ctx,long_remat")
     args = ap.parse_args()
 
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tpuframe_xla_cache")
     import jax
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
-        )
-    except Exception:
-        pass
     import bench as headline_bench
+
+    headline_bench.enable_compile_cache()
 
     verdict, detail = headline_bench._preflight(dict(os.environ), 180.0)
     if verdict != "ok":

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpuframe.ops.ring_attention import _block_update
+from tpuframe.ops.ring_attention import _block_update, _causal_skip, _tile_grads
 
 __all__ = ["blockwise_attention"]
 
@@ -58,32 +58,6 @@ def _to_blocks(a, n, block):
 def _from_blocks(a):
     n, b, block, h, d = a.shape
     return a.transpose(1, 0, 2, 3, 4).reshape(b, n * block, h, d)
-
-
-def _tile_grads(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
-                q_pos, k_pos, causal, scale, kv_len):
-    """(p, ds) for one (Q block, K/V block) tile of the flash backward.
-
-    Probabilities are recomputed from the saved logsumexp —
-    ``p = exp(s - lse)`` — so nothing O(L^2) is ever stored.  Fully
-    masked rows have ``lse = -inf``; masking s to -inf first makes
-    ``exp`` produce exact zeros for them.
-    """
-    s = (
-        jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk,
-                   preferred_element_type=jnp.float32)
-        * scale
-    )
-    valid = (k_pos < kv_len)[None, :]
-    if causal:
-        valid = valid & (k_pos[None, :] <= q_pos[:, None])
-    s = jnp.where(valid[None, None], s, -jnp.inf)
-    lse_safe = jnp.where(jnp.isneginf(lse_blk), 0.0, lse_blk)
-    p = jnp.exp(s - lse_safe[..., None])  # (B, H, bq, bk) f32, exact rows
-    dp = jnp.einsum("bqhd,bkhd->bhqk", do_blk, v_blk,
-                    preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_blk[..., None]) * scale
-    return p, ds
 
 
 def _fwd_schedule(q_blocks, k_blocks, v_blocks, causal, scale, block, kv_len):
@@ -109,14 +83,11 @@ def _fwd_schedule(q_blocks, k_blocks, v_blocks, causal, scale, block, kv_len):
                     causal, scale, kv_len=kv_len,
                 )
 
-            if causal:
-                # k_idx/q_idx are scalars inside the scan, so lax.cond
-                # lowers to a real branch: tiles entirely above the
-                # diagonal are SKIPPED at runtime, not just masked —
-                # ~half the causal sweep's matmuls never execute
-                carry = lax.cond(k_idx <= q_idx, update, lambda c: c, carry)
-            else:
-                carry = update(carry)
+            # tiles entirely above the diagonal are SKIPPED at runtime,
+            # not just masked — ~half the causal sweep never executes
+            carry = _causal_skip(
+                (k_idx <= q_idx) if causal else None, update, carry
+            )
             return carry, None
 
         (o, lsum, m), _ = lax.scan(
@@ -193,10 +164,7 @@ def _blockwise_padded_bwd(causal, block, kv_len, res, g):
                     preferred_element_type=jnp.float32,
                 )
 
-            if causal:  # skip tiles above the diagonal (see forward)
-                dq = lax.cond(k_idx <= q_idx, update, lambda a: a, dq)
-            else:
-                dq = update(dq)
+            dq = _causal_skip((k_idx <= q_idx) if causal else None, update, dq)
             return dq, None
 
         dq0 = jnp.zeros((b, block, h, d), jnp.float32)
@@ -231,10 +199,9 @@ def _blockwise_padded_bwd(causal, block, kv_len, res, g):
                 )
                 return dk, dv
 
-            if causal:  # skip tiles above the diagonal (see forward)
-                carry = lax.cond(q_idx >= k_idx, update, lambda c: c, carry)
-            else:
-                carry = update(carry)
+            carry = _causal_skip(
+                (q_idx >= k_idx) if causal else None, update, carry
+            )
             return carry, None
 
         zero = jnp.zeros((b, block, h, d), jnp.float32)
