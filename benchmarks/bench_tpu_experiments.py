@@ -108,18 +108,13 @@ def main() -> None:
     ap.add_argument("--configs", default="baseline,bn_bf16")
     args = ap.parse_args()
 
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tpuframe_xla_cache")
     import jax
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
-        )
-    except Exception:
-        pass
     # tiny-compile preflight (bench.py's): a wedged remote-compile helper
     # hangs compiles forever — fail visibly in bounded time instead
     import bench as headline_bench
+
+    headline_bench.enable_compile_cache()
 
     verdict, detail = headline_bench._preflight(dict(os.environ), 180.0)
     if verdict != "ok":
