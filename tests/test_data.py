@@ -450,3 +450,141 @@ def test_streaming_dataset_pickles_as_handle(tmp_path):
     clone = pickle.loads(pickle.dumps(ds))
     img, label = clone[5]
     assert label == 5 and img[0, 0, 0] == 5
+
+
+def test_loader_state_dict_mid_epoch_resume():
+    """Crash/resume parity with mosaicml-streaming's resumable iteration:
+    a fresh loader restored from state_dict continues with the very next
+    batch of the same (seed, epoch) order — no replays, no skips."""
+    ds = SyntheticImageDataset(n=32, image_size=2)
+    full = [
+        labels.tolist()
+        for _, labels in DataLoader(ds, batch_size=4, shuffle=True, seed=7,
+                                    process_index=0, process_count=1)
+    ]
+
+    loader = DataLoader(ds, batch_size=4, shuffle=True, seed=7,
+                        process_index=0, process_count=1)
+    it = iter(loader)
+    consumed = [next(it)[1].tolist() for _ in range(3)]
+    snapshot = loader.state_dict()
+    assert snapshot["epoch"] == 0 and snapshot["batches_yielded"] == 3
+    del it, loader  # "crash"
+
+    resumed = DataLoader(ds, batch_size=4, shuffle=True, seed=7,
+                         process_index=0, process_count=1)
+    resumed.load_state_dict(snapshot)
+    rest = [labels.tolist() for _, labels in resumed]
+    assert consumed + rest == full
+    # the next epoch starts clean
+    resumed.set_epoch(1)
+    assert len(list(resumed)) == len(full)
+
+
+def test_loader_state_dict_after_epoch_end_yields_nothing():
+    """Resuming a fully-consumed epoch must not replay it; bumping the
+    epoch afterwards iterates normally (trainer auto-resume contract)."""
+    ds = SyntheticImageDataset(n=16, image_size=2)
+    loader = DataLoader(ds, batch_size=4, shuffle=True, seed=0,
+                        process_index=0, process_count=1)
+    n = len(list(loader))
+    snapshot = loader.state_dict()
+    assert snapshot["batches_yielded"] == n
+
+    resumed = DataLoader(ds, batch_size=4, shuffle=True, seed=0,
+                         process_index=0, process_count=1)
+    resumed.load_state_dict(snapshot)
+    assert list(resumed) == []
+    resumed.set_epoch(1)
+    assert len(list(resumed)) == n
+
+
+def test_loader_state_dict_resume_with_padded_tail():
+    """drop_last=False: the padded tail batch counts as a position too."""
+    ds = SyntheticImageDataset(n=10, image_size=2)
+    full = list(DataLoader(ds, batch_size=4, drop_last=False,
+                           process_index=0, process_count=1))
+
+    loader = DataLoader(ds, batch_size=4, drop_last=False,
+                        process_index=0, process_count=1)
+    it = iter(loader)
+    next(it)
+    resumed = DataLoader(ds, batch_size=4, drop_last=False,
+                         process_index=0, process_count=1)
+    resumed.load_state_dict(loader.state_dict())
+    rest = list(resumed)
+    assert len(rest) == len(full) - 1
+    for (ia, la, va), (ib, lb, vb) in zip(rest, full[1:]):
+        assert la.tolist() == lb.tolist() and va.tolist() == vb.tolist()
+
+
+def test_loader_state_dict_fingerprint_mismatch_raises():
+    """A position saved under a different batch size/topology/seed indexes
+    a different permutation — resuming there must fail, not silently
+    replay/skip samples."""
+    import pytest as _pytest
+
+    ds = SyntheticImageDataset(n=32, image_size=2)
+    saved = DataLoader(ds, batch_size=8, shuffle=True, seed=1,
+                       process_index=0, process_count=1).state_dict()
+    other = DataLoader(ds, batch_size=4, shuffle=True, seed=1,
+                       process_index=0, process_count=1)
+    with _pytest.raises(ValueError, match="fingerprint mismatch"):
+        other.load_state_dict(saved)
+
+
+def test_prefetcher_state_dict_tracks_consumed_not_prefetched():
+    """The loader's own counter runs ahead of training by up to `depth`
+    batches; the prefetcher's state_dict must report the batch the
+    consumer actually received (else resume would skip never-trained
+    samples)."""
+    import time as _time
+
+    from tpuframe.core import MeshSpec, initialize
+    from tpuframe.core import runtime as rt_mod
+
+    rt_mod.reset_runtime()
+    initialize(MeshSpec(data=-1))
+    try:
+        ds = SyntheticImageDataset(n=64, image_size=4)
+        loader = DataLoader(ds, batch_size=8, shuffle=True, seed=3,
+                            process_index=0, process_count=1)
+        pf = DevicePrefetcher(loader, depth=3, track_loader=loader)
+        assert pf.state_dict()["batches_yielded"] == 0
+        it = iter(pf)
+        next(it)
+        next(it)
+        # give the background thread time to prefetch ahead
+        deadline = _time.time() + 5
+        while loader.state_dict()["batches_yielded"] <= 2 and _time.time() < deadline:
+            _time.sleep(0.01)
+        assert loader.state_dict()["batches_yielded"] > 2  # producer ran ahead
+        assert pf.state_dict()["batches_yielded"] == 2     # consumer truth
+        # the snapshot resumes a fresh loader exactly after batch 2
+        resumed = DataLoader(ds, batch_size=8, shuffle=True, seed=3,
+                             process_index=0, process_count=1)
+        resumed.load_state_dict(pf.state_dict())
+        full = [lb.tolist() for _, lb in
+                DataLoader(ds, batch_size=8, shuffle=True, seed=3,
+                           process_index=0, process_count=1)]
+        rest = [lb.tolist() for _, lb in resumed]
+        assert rest == full[2:]
+        del it
+    finally:
+        rt_mod.reset_runtime()
+
+
+def test_loader_set_epoch_rewinds_position():
+    """state_dict after set_epoch(e) but before the first batch must read
+    'epoch e, position 0' — not the previous epoch's end."""
+    ds = SyntheticImageDataset(n=16, image_size=2)
+    loader = DataLoader(ds, batch_size=4, shuffle=True, seed=0,
+                        process_index=0, process_count=1)
+    assert len(list(loader)) == 4
+    loader.set_epoch(1)
+    sd = loader.state_dict()
+    assert sd["epoch"] == 1 and sd["batches_yielded"] == 0
+    resumed = DataLoader(ds, batch_size=4, shuffle=True, seed=0,
+                         process_index=0, process_count=1)
+    resumed.load_state_dict(sd)
+    assert len(list(resumed)) == 4  # the whole epoch 1, nothing skipped

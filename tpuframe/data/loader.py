@@ -115,6 +115,8 @@ class DataLoader:
         self.worker_mode = worker_mode
         self._proc_pool = None
         self._epoch = 0
+        self._batches_yielded = 0  # within the current epoch (resume point)
+        self._resume_offset = 0  # batches to skip on the next __iter__
         if num_workers and worker_mode == "process":
             # Fork NOW, from the constructing (main) thread — a lazy fork
             # from DevicePrefetcher's background thread while jax/XLA
@@ -134,10 +136,82 @@ class DataLoader:
         self.local_batch_size = self.global_batch_size // self.process_count
 
     def set_epoch(self, epoch: int) -> None:
-        """DistributedSampler.set_epoch parity — changes the shuffle order."""
+        """DistributedSampler.set_epoch parity — changes the shuffle order.
+
+        Also rewinds the position counters: a ``state_dict`` taken after
+        ``set_epoch(e)`` but before the epoch's first batch must read
+        "epoch e, nothing consumed", not the previous epoch's end.
+        (``load_state_dict`` re-applies its offset after calling this.)
+        """
         self._epoch = int(epoch)
+        self._batches_yielded = 0
+        self._resume_offset = 0
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
+
+    def state_dict(self) -> dict:
+        """Deterministic mid-epoch resume point (mosaicml-streaming's
+        ``StreamingDataset.state_dict`` capability, surfaced at the loader
+        where tpuframe's iteration order lives).
+
+        Returns the position plus an iteration-order fingerprint — the
+        permutation is a pure function of (seed, epoch, topology), so the
+        fingerprint is what makes the position transferable.  Save it
+        next to the model checkpoint; after a crash, ``load_state_dict``
+        + iterate continues with the very next batch, no replayed or
+        skipped samples.  One live iterator per loader is assumed
+        (concurrent iterators would share this counter).  NOTE: when the
+        loader is consumed through :class:`DevicePrefetcher`, take the
+        snapshot from the *prefetcher's* ``state_dict()`` — the loader's
+        own counter runs up to ``depth`` batches ahead of what training
+        actually consumed.
+        """
+        return {
+            "epoch": self._epoch,
+            "batches_yielded": self._batches_yielded,
+            "global_batch_size": self.global_batch_size,
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "dataset_len": len(self.dataset),
+            "seed": self.seed,
+            "shuffle": self.shuffle,
+            "drop_last": self.drop_last,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Resume from :meth:`state_dict`: the next ``__iter__`` skips the
+        already-consumed batches by index arithmetic (no fetch/decode of
+        skipped samples) and continues the same (seed, epoch) order.
+
+        Raises ``ValueError`` when the snapshot's iteration-order
+        fingerprint doesn't match this loader — a position saved under a
+        different batch size, topology, seed, or dataset indexes a
+        different permutation, and resuming there would silently replay
+        and skip samples.
+        """
+        mine = self.state_dict()
+        mismatched = {
+            k: (state.get(k), mine[k])
+            for k in ("global_batch_size", "process_index", "process_count",
+                      "dataset_len", "seed", "shuffle", "drop_last")
+            if k in state and state[k] != mine[k]
+        }
+        if mismatched:
+            raise ValueError(
+                "loader state_dict fingerprint mismatch (saved != current): "
+                + ", ".join(f"{k}: {a!r} != {b!r}"
+                            for k, (a, b) in mismatched.items())
+            )
+        offset = int(state["batches_yielded"])
+        if not 0 <= offset <= len(self):
+            # negative offsets would wrap python slices and silently
+            # replay end-of-epoch batches
+            raise ValueError(
+                f"batches_yielded {offset} outside [0, {len(self)}]"
+            )
+        self.set_epoch(int(state["epoch"]))
+        self._resume_offset = offset
+        self._batches_yielded = offset
 
     def _per_process_count(self) -> int:
         n = len(self.dataset)
@@ -222,17 +296,27 @@ class DataLoader:
             # plain Python ints: torch-style datasets (the reference's
             # map-style Dataset contract) often reject numpy indices
             fetch = lambda idxs: [self.dataset[int(i)] for i in idxs]  # noqa: E731
+        # mid-epoch resume: skip already-consumed batches arithmetically
+        # (the permutation is (seed, epoch)-deterministic, so no fetch of
+        # skipped samples is needed); a fresh epoch starts at 0
+        start = min(self._resume_offset, len(self))
+        self._resume_offset = 0
+        self._batches_yielded = start
         try:
-            for b in range(nb_full):
+            for b in range(start, nb_full):
                 sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)
                 items = fetch(indices[sl])
                 images = np.stack([im for im, _ in items])
                 labels = np.asarray([lb for _, lb in items], np.int32)
+                # count BEFORE the yield: a generator suspends AT the
+                # yield, so a post-yield update would lag one batch behind
+                # what the caller has already consumed
+                self._batches_yielded = b + 1
                 if self.drop_last:
                     yield images, labels
                 else:
                     yield images, labels, genuine[sl].copy()
-            if tail and not self.drop_last:
+            if tail and not self.drop_last and start <= nb_full:
                 sl = slice(nb_full * self.local_batch_size, None)
                 items = fetch(indices[sl])
                 pad = self.local_batch_size - len(items)
@@ -241,6 +325,7 @@ class DataLoader:
                     [lb for _, lb in items] + [items[-1][1]] * pad, np.int32
                 )
                 valid = np.concatenate([genuine[sl], np.zeros(pad, bool)])
+                self._batches_yielded = nb_full + 1
                 yield images, labels, valid
         finally:
             if pool:
@@ -259,12 +344,32 @@ class DevicePrefetcher:
 
     _DONE = object()
 
-    def __init__(self, it: Any, depth: int = 2, sharding=None):
+    def __init__(self, it: Any, depth: int = 2, sharding=None,
+                 track_loader: "DataLoader | None" = None):
         self.it = it
         if sharding is None:
             sharding = rt.current_runtime().data_sharding()
         self.sharding = sharding
         self.depth = max(1, depth)
+        # Mid-epoch-resume position of the batch most recently handed to
+        # the CONSUMER.  The wrapped loader's own counter runs up to
+        # ``depth`` batches ahead (the background thread prefetches), so
+        # each queue item carries the loader snapshot taken at pull time
+        # and the position only advances when the consumer receives it.
+        self.track_loader = track_loader
+        self._position = (
+            track_loader.state_dict() if track_loader is not None else None
+        )
+
+    def state_dict(self) -> dict:
+        """Resume point of the last batch the consumer actually received
+        (see :meth:`DataLoader.state_dict`; requires ``track_loader=``)."""
+        if self.track_loader is None:
+            raise ValueError(
+                "DevicePrefetcher was built without track_loader=; no "
+                "resume position to report"
+            )
+        return dict(self._position)
 
     def _put(self, batch):
         """Any pytree of host arrays (tuple / dict / nested) -> global Arrays."""
@@ -299,7 +404,16 @@ class DevicePrefetcher:
         def worker():
             try:
                 for batch in self.it:
-                    if not put(self._put(batch)):
+                    # snapshot right after the pull: this is the position
+                    # of exactly the batch being enqueued (pulling may
+                    # advance the loader by several batches, e.g. the
+                    # trainer's grad-accum grouping)
+                    snap = (
+                        self.track_loader.state_dict()
+                        if self.track_loader is not None
+                        else None
+                    )
+                    if not put((self._put(batch), snap)):
                         return  # consumer went away
             except BaseException as e:  # propagate to consumer
                 err.append(e)
@@ -315,7 +429,10 @@ class DevicePrefetcher:
                     if err:
                         raise err[0]
                     return
-                yield item
+                batch, snap = item
+                if snap is not None:
+                    self._position = snap
+                yield batch
         finally:
             # Early consumer exit (break / GeneratorExit): release the worker
             # so it doesn't pin `depth` device batches forever.
