@@ -475,3 +475,86 @@ class TestDeviceNormalize:
         )
         result = trainer.fit()
         assert np.isfinite(result.metrics["train_loss"])
+
+
+class TestMidEpochResume:
+    @pytest.mark.slow
+    def test_crash_resumes_with_next_batch_not_replay(self, tmp_path):
+        """checkpoint_interval_batches bundles the consumer-true loader
+        position; a fresh Trainer over the same checkpointer continues the
+        epoch from that batch (batches_seen ends at exactly one epoch's
+        worth, which is impossible if the epoch restarted from batch 0)."""
+        from tpuframe.ckpt import Checkpointer
+
+        def make():
+            ds = SyntheticImageDataset(n=128, image_size=28, channels=1,
+                                       num_classes=4)
+            lt = DataLoader(ds, batch_size=16, shuffle=True, seed=5,
+                            process_index=0, process_count=1)
+            return Trainer(
+                MnistNet(num_classes=4),
+                train_dataloader=lt,
+                max_duration="8ba",  # one full epoch is 8 batches
+                lr=1e-3,
+                num_classes=4,
+                log_interval=0,
+                checkpointer=Checkpointer(tmp_path / "ck"),
+                checkpoint_interval_batches=3,
+            )
+
+        from tpuframe.train.callbacks import Callback
+
+        class Bomb(Callback):
+            """Simulate a hard crash mid-epoch (a duration-stop would
+            legitimately write an epoch-end checkpoint; a crash must not)."""
+
+            def __init__(self):
+                self.n = 0
+
+            def on_step_end(self, trainer, *a):
+                self.n += 1
+                if self.n >= 5:
+                    raise RuntimeError("boom")
+
+        first = make()
+        first.callbacks = list(first.callbacks) + [Bomb()]
+        with pytest.raises(RuntimeError, match="boom"):
+            first.fit()
+        assert first.batches_seen == 5  # crashed; last save was batch 3
+
+        resumed = make()
+        result = resumed.fit()
+        # restored at batches_seen=3, trained batches 4..8 of the SAME epoch
+        assert resumed.batches_seen == 8
+        assert resumed.epoch == 1
+        # the resumed run made 5 optimizer steps on top of the restored 3
+        assert int(resumed.state.step) == 8
+        assert result.error is None
+
+    @pytest.mark.slow
+    def test_batch_interval_colliding_with_epoch_end(self, tmp_path):
+        """checkpoint_interval_batches dividing the epoch length makes the
+        mid-epoch save land on the epoch-end step: the epoch-end record
+        must supersede it (no StepAlreadyExistsError) and the batch-4
+        snapshot must be pruned once the epoch completes."""
+        from tpuframe.ckpt import Checkpointer
+
+        ds = SyntheticImageDataset(n=128, image_size=28, channels=1,
+                                   num_classes=4)
+        lt = DataLoader(ds, batch_size=16, shuffle=True, seed=5,
+                        process_index=0, process_count=1)
+        ck = Checkpointer(tmp_path / "ck2")
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=lt,
+            max_duration="1ep",  # 8 batches
+            lr=1e-3,
+            num_classes=4,
+            log_interval=0,
+            checkpointer=ck,
+            checkpoint_interval_batches=4,
+        )
+        trainer.fit()
+        assert ck.all_steps() == [8]  # intra-epoch step 4 pruned
+        _, meta = ck.restore(trainer.state)
+        assert meta["epoch"] == 1 and "loader_state" not in meta

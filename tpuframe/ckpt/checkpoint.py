@@ -155,6 +155,14 @@ class Checkpointer:
     def all_steps(self) -> list[int]:
         return sorted(self._mgr.all_steps())
 
+    def delete(self, step: int) -> None:
+        """Remove one step's checkpoint (e.g. a mid-epoch snapshot
+        superseded by the epoch-end save); missing steps are a no-op."""
+        try:
+            self._mgr.delete(step)
+        except Exception:
+            pass  # already gone / never existed
+
     def metrics_for(self, step: int) -> dict:
         """The metrics JSON bundled with ``step`` (Ray-style result reload)."""
         restored = self._mgr.restore(

@@ -92,6 +92,10 @@ class Trainer:
         compute with f32 master params).
       checkpointer: tpuframe.ckpt.Checkpointer (optional; saved per
         ``checkpoint_interval`` epochs + best tracking).
+      checkpoint_interval_batches: additionally save every N global
+        batches *inside* an epoch, bundling the consumer-true loader
+        position — a crash then auto-resumes with the very next batch
+        (deterministic mid-epoch resume) instead of replaying the epoch.
       eval_interval: run eval every N epochs (0 = never).
     """
 
@@ -116,6 +120,7 @@ class Trainer:
         sample_input: np.ndarray | None = None,
         checkpointer: Any = None,
         checkpoint_interval: int = 1,
+        checkpoint_interval_batches: int | None = None,
         eval_interval: int = 1,
         log_interval: int = 10,
         report: Callable[[dict, str | None], None] | None = None,
@@ -144,6 +149,7 @@ class Trainer:
         self.seed = seed
         self.checkpointer = checkpointer
         self.checkpoint_interval = checkpoint_interval
+        self.checkpoint_interval_batches = checkpoint_interval_batches
         self.eval_interval = eval_interval
         self.log_interval = log_interval
         self.report = report
@@ -192,6 +198,11 @@ class Trainer:
         self.batches_seen = 0
         self.samples_seen = 0
         self._stop_reason: str | None = None
+        # mid-epoch resume: loader position restored from a checkpoint,
+        # applied at the next epoch start (after its set_epoch rewind)
+        self._pending_loader_state: dict | None = None
+        self._train_prefetcher: DevicePrefetcher | None = None
+        self._intra_epoch_steps: list[int | None] = []
 
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -320,9 +331,16 @@ class Trainer:
         """Host pipeline: algorithms -> dict batches -> prefetched global arrays."""
         algs = self.algorithms if train else []
         accum = self.grad_accum if train else 1
-        base_rng = np.random.default_rng(
-            (self.seed * 1_000_003 + self.epoch) * 2 + int(train)
-        )
+        run_key = (self.seed * 1_000_003 + self.epoch) * 2 + int(train)
+
+        def batch_rng() -> np.random.Generator:
+            """Augmentation rng keyed by (run, absolute batch position) —
+            stateless, so a mid-epoch resume applies the SAME augmentation
+            draws to batch k as the uninterrupted run would (a single
+            sequential rng would hand the skipped batches' draws to the
+            resumed ones)."""
+            pos = getattr(loader, "_batches_yielded", 0)
+            return np.random.default_rng(run_key * 1_000_003 + pos)
 
         def split_micro(x: np.ndarray) -> np.ndarray:
             if x.shape[0] % accum:
@@ -347,7 +365,9 @@ class Trainer:
             for batch in loader:
                 images, labels = np.asarray(batch[0]), np.asarray(batch[1])
                 if algs:
-                    images, labels = apply_algorithms(algs, images, labels, base_rng)
+                    images, labels = apply_algorithms(
+                        algs, images, labels, batch_rng()
+                    )
                 out = {"image": images, "label": labels}
                 if len(batch) > 2:
                     out["weight"] = np.asarray(batch[2], np.float32)
@@ -355,10 +375,16 @@ class Trainer:
                     out = {k: split_micro(v) for k, v in out.items()}
                 yield out
 
-        yield from DevicePrefetcher(
+        pf = DevicePrefetcher(
             host_iter(),
             sharding=self.plan.batch_sharding(leading_microbatch=accum > 1),
+            # consumer-true resume position for mid-epoch checkpoints (the
+            # loader's own counter runs `depth` batches ahead)
+            track_loader=loader if train else None,
         )
+        if train:
+            self._train_prefetcher = pf
+        yield from pf
 
     # -- the loop ----------------------------------------------------------
     def fit(self) -> FitResult:
@@ -372,6 +398,9 @@ class Trainer:
                 self.epoch = int(restored_meta.get("epoch", 0))
                 self.batches_seen = int(restored_meta.get("batches_seen", 0))
                 self.samples_seen = int(restored_meta.get("samples_seen", 0))
+                # a mid-epoch checkpoint carries the loader position;
+                # applied after _run_epoch's set_epoch rewind
+                self._pending_loader_state = restored_meta.get("loader_state")
 
         self._log_params(
             {
@@ -410,6 +439,14 @@ class Trainer:
                 if self.checkpointer is not None and (
                     (self.epoch + 1) % self.checkpoint_interval == 0
                 ):
+                    # a mid-epoch save may already occupy this exact step
+                    # (checkpoint_interval_batches dividing the epoch's
+                    # last batch); the epoch-end record supersedes it —
+                    # drop the snapshot first (orbax refuses same-step
+                    # saves even with force)
+                    step_now = int(jax.device_get(self.state.step))
+                    if self.checkpointer.latest_step() == step_now:
+                        self.checkpointer.delete(step_now)
                     ckpt_path = self.checkpointer.save(
                         self.state,
                         metrics=epoch_summary,
@@ -420,6 +457,13 @@ class Trainer:
                         },
                     )
                     result.checkpoint = str(ckpt_path)
+                    # Composer-style cleanup: intra-epoch snapshots are
+                    # superseded by the epoch-end save — drop them so they
+                    # can't evict real epoch checkpoints from retention
+                    for s in self._intra_epoch_steps:
+                        if s is not None and s != step_now:
+                            self.checkpointer.delete(s)
+                    self._intra_epoch_steps.clear()
                 if self.report is not None:
                     self.report(epoch_summary, result.checkpoint)
                 self.epoch += 1
@@ -446,6 +490,11 @@ class Trainer:
     def _run_epoch(self) -> dict[str, float]:
         self._emit("on_epoch_start", self.epoch)
         self.train_dataloader.set_epoch(self.epoch)
+        if self._pending_loader_state is not None:
+            # resume mid-epoch: skip the already-trained batches of this
+            # epoch (this epoch's summary then covers only the remainder)
+            self.train_dataloader.load_state_dict(self._pending_loader_state)
+            self._pending_loader_state = None
         acc = None
         window = None  # device-side metric pytree, materialized per interval
         t0 = time.perf_counter()
@@ -477,6 +526,26 @@ class Trainer:
             dispatch += time.perf_counter() - ts
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
+            if (
+                self.checkpointer is not None
+                and self.checkpoint_interval_batches
+                and self.batches_seen % self.checkpoint_interval_batches == 0
+            ):
+                # mid-epoch save: model/optimizer state + the consumer-true
+                # loader position, so a crash resumes with the very next
+                # batch (no replayed or skipped samples)
+                self.checkpointer.save(
+                    self.state,
+                    meta={
+                        "epoch": self.epoch,
+                        "batches_seen": self.batches_seen,
+                        "samples_seen": self.samples_seen,
+                        "loader_state": self._train_prefetcher.state_dict(),
+                    },
+                )
+                self._intra_epoch_steps.append(
+                    self.checkpointer.latest_step()
+                )
             # Accumulate on device (async) — floating every step would
             # block the host on each step's completion and serialize the
             # pipeline.
