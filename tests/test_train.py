@@ -532,11 +532,11 @@ class TestMidEpochResume:
         assert result.error is None
 
     @pytest.mark.slow
-    def test_batch_interval_colliding_with_epoch_end(self, tmp_path):
-        """checkpoint_interval_batches dividing the epoch length makes the
-        mid-epoch save land on the epoch-end step: the epoch-end record
-        must supersede it (no StepAlreadyExistsError) and the batch-4
-        snapshot must be pruned once the epoch completes."""
+    def test_snapshots_isolated_from_epoch_checkpoints(self, tmp_path):
+        """Mid-epoch snapshots live in a sibling dir with max_to_keep=1:
+        they never collide with or evict epoch-end checkpoints, and the
+        epoch-final batch is not snapshotted (the epoch-end save follows
+        immediately)."""
         from tpuframe.ckpt import Checkpointer
 
         ds = SyntheticImageDataset(n=128, image_size=28, channels=1,
@@ -552,9 +552,11 @@ class TestMidEpochResume:
             num_classes=4,
             log_interval=0,
             checkpointer=ck,
-            checkpoint_interval_batches=4,
+            checkpoint_interval_batches=2,  # batches 2, 4, 6 (8 skipped)
         )
         trainer.fit()
-        assert ck.all_steps() == [8]  # intra-epoch step 4 pruned
+        assert ck.all_steps() == [8]  # epoch-end only; no snapshot pollution
         _, meta = ck.restore(trainer.state)
         assert meta["epoch"] == 1 and "loader_state" not in meta
+        intra = Checkpointer(str(tmp_path / "ck2") + "_intra")
+        assert intra.all_steps() == [6]  # max_to_keep=1; final batch skipped

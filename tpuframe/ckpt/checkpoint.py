@@ -156,11 +156,11 @@ class Checkpointer:
         return sorted(self._mgr.all_steps())
 
     def delete(self, step: int) -> None:
-        """Remove one step's checkpoint (e.g. a mid-epoch snapshot
-        superseded by the epoch-end save); missing steps are a no-op."""
+        """Remove one step's checkpoint; a missing step is a no-op, any
+        other failure (I/O, in-flight async save) propagates."""
         try:
             self._mgr.delete(step)
-        except Exception:
+        except (FileNotFoundError, KeyError):
             pass  # already gone / never existed
 
     def metrics_for(self, step: int) -> dict:

@@ -166,11 +166,14 @@ class DataLoader:
         own counter runs up to ``depth`` batches ahead of what training
         actually consumed.
         """
+        # NOTE: no process_index — the position is rank-uniform (every
+        # process consumes the same batch count in lockstep), so rank 0's
+        # snapshot must restore cleanly on every other process (the
+        # checkpoint meta is written once, globally)
         return {
             "epoch": self._epoch,
             "batches_yielded": self._batches_yielded,
             "global_batch_size": self.global_batch_size,
-            "process_index": self.process_index,
             "process_count": self.process_count,
             "dataset_len": len(self.dataset),
             "seed": self.seed,
@@ -192,7 +195,7 @@ class DataLoader:
         mine = self.state_dict()
         mismatched = {
             k: (state.get(k), mine[k])
-            for k in ("global_batch_size", "process_index", "process_count",
+            for k in ("global_batch_size", "process_count",
                       "dataset_len", "seed", "shuffle", "drop_last")
             if k in state and state[k] != mine[k]
         }

@@ -202,7 +202,7 @@ class Trainer:
         # applied at the next epoch start (after its set_epoch rewind)
         self._pending_loader_state: dict | None = None
         self._train_prefetcher: DevicePrefetcher | None = None
-        self._intra_epoch_steps: list[int | None] = []
+        self._intra_ck: Any = None  # lazy sibling checkpointer (snapshots)
 
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -294,6 +294,26 @@ class Trainer:
         """Callbacks call this to end fit() after the current epoch."""
         self._stop_reason = reason
 
+    def _intra_checkpointer(self):
+        """Sibling checkpointer for mid-epoch snapshots, ``max_to_keep=1``.
+
+        A SEPARATE directory keeps snapshots out of the main
+        checkpointer's retention (frequent snapshots would evict real
+        epoch-end checkpoints mid-epoch) and out of its step namespace
+        (an epoch-end save landing on a snapshot's optimizer step would
+        otherwise collide).  Only the most recent snapshot matters for
+        crash-resume, so one is kept.
+        """
+        if self._intra_ck is None and (
+            self.checkpointer is not None and self.checkpoint_interval_batches
+        ):
+            from tpuframe.ckpt import Checkpointer
+
+            self._intra_ck = Checkpointer(
+                str(self.checkpointer.directory) + "_intra", max_to_keep=1
+            )
+        return self._intra_ck
+
     def _emit(self, hook: str, *args) -> None:
         for cb in self.callbacks:
             getattr(cb, hook)(self, *args)
@@ -333,13 +353,19 @@ class Trainer:
         accum = self.grad_accum if train else 1
         run_key = (self.seed * 1_000_003 + self.epoch) * 2 + int(train)
 
+        fallback_pos = iter(range(1, 1 << 62))
+
         def batch_rng() -> np.random.Generator:
             """Augmentation rng keyed by (run, absolute batch position) —
             stateless, so a mid-epoch resume applies the SAME augmentation
             draws to batch k as the uninterrupted run would (a single
             sequential rng would hand the skipped batches' draws to the
-            resumed ones)."""
-            pos = getattr(loader, "_batches_yielded", 0)
+            resumed ones).  Duck-typed iterables without a position
+            counter fall back to a local sequence (distinct draws per
+            batch; mid-epoch resume isn't supported for those anyway)."""
+            pos = getattr(loader, "_batches_yielded", None)
+            if pos is None:
+                pos = next(fallback_pos)
             return np.random.default_rng(run_key * 1_000_003 + pos)
 
         def split_micro(x: np.ndarray) -> np.ndarray:
@@ -392,13 +418,24 @@ class Trainer:
         result = FitResult()
         state = self.init_state()
         if self.checkpointer is not None:
-            state, restored_meta = self.checkpointer.maybe_restore(state)
+            # auto-resume from whichever is newer: the last epoch-end
+            # checkpoint or a mid-epoch snapshot (crash inside an epoch)
+            source = self.checkpointer
+            intra = self._intra_checkpointer()
+            if intra is not None:
+                main_step = self.checkpointer.latest_step()
+                intra_step = intra.latest_step()
+                if intra_step is not None and (
+                    main_step is None or intra_step > main_step
+                ):
+                    source = intra
+            state, restored_meta = source.maybe_restore(state)
             self.state = state
             if restored_meta:
                 self.epoch = int(restored_meta.get("epoch", 0))
                 self.batches_seen = int(restored_meta.get("batches_seen", 0))
                 self.samples_seen = int(restored_meta.get("samples_seen", 0))
-                # a mid-epoch checkpoint carries the loader position;
+                # a mid-epoch snapshot carries the loader position;
                 # applied after _run_epoch's set_epoch rewind
                 self._pending_loader_state = restored_meta.get("loader_state")
 
@@ -439,14 +476,6 @@ class Trainer:
                 if self.checkpointer is not None and (
                     (self.epoch + 1) % self.checkpoint_interval == 0
                 ):
-                    # a mid-epoch save may already occupy this exact step
-                    # (checkpoint_interval_batches dividing the epoch's
-                    # last batch); the epoch-end record supersedes it —
-                    # drop the snapshot first (orbax refuses same-step
-                    # saves even with force)
-                    step_now = int(jax.device_get(self.state.step))
-                    if self.checkpointer.latest_step() == step_now:
-                        self.checkpointer.delete(step_now)
                     ckpt_path = self.checkpointer.save(
                         self.state,
                         metrics=epoch_summary,
@@ -457,13 +486,6 @@ class Trainer:
                         },
                     )
                     result.checkpoint = str(ckpt_path)
-                    # Composer-style cleanup: intra-epoch snapshots are
-                    # superseded by the epoch-end save — drop them so they
-                    # can't evict real epoch checkpoints from retention
-                    for s in self._intra_epoch_steps:
-                        if s is not None and s != step_now:
-                            self.checkpointer.delete(s)
-                    self._intra_epoch_steps.clear()
                 if self.report is not None:
                     self.report(epoch_summary, result.checkpoint)
                 self.epoch += 1
@@ -526,15 +548,24 @@ class Trainer:
             dispatch += time.perf_counter() - ts
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
+            try:
+                epoch_len = len(self.train_dataloader) or 1
+            except TypeError:  # duck-typed iterable without __len__
+                epoch_len = 1 << 62
             if (
                 self.checkpointer is not None
                 and self.checkpoint_interval_batches
                 and self.batches_seen % self.checkpoint_interval_batches == 0
+                # the epoch-final batch is followed immediately by the
+                # epoch-end save — a snapshot there would be a throwaway
+                # full serialization of the same state
+                and self.batches_seen % epoch_len != 0
             ):
-                # mid-epoch save: model/optimizer state + the consumer-true
-                # loader position, so a crash resumes with the very next
-                # batch (no replayed or skipped samples)
-                self.checkpointer.save(
+                # mid-epoch snapshot (sibling checkpointer): model/opt
+                # state + the consumer-true loader position, so a crash
+                # resumes with the very next batch (no replayed or
+                # skipped samples)
+                self._intra_checkpointer().save(
                     self.state,
                     meta={
                         "epoch": self.epoch,
@@ -542,9 +573,6 @@ class Trainer:
                         "samples_seen": self.samples_seen,
                         "loader_state": self._train_prefetcher.state_dict(),
                     },
-                )
-                self._intra_epoch_steps.append(
-                    self.checkpointer.latest_step()
                 )
             # Accumulate on device (async) — floating every step would
             # block the host on each step's completion and serialize the
