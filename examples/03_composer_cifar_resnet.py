@@ -48,6 +48,9 @@ def main(argv=None):
             os.path.join(args.workdir, "composer", "ckpt"),
             best_metric="eval_loss", best_mode="min",
         ),
+        # mid-epoch snapshots (sibling dir, deterministic resume): a crash
+        # auto-resumes with the very next batch instead of the epoch start
+        checkpoint_interval_batches=50,
         seed=args.seed,
     )
     result = trainer.fit()

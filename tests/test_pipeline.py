@@ -156,6 +156,7 @@ class TestPipelinedLM:
         assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_remat_stages_identical_numerics():
     """remat_stages trades FLOPs for memory; outputs AND gradients must be
     bit-comparable to the non-remat schedule."""

@@ -548,32 +548,35 @@ class Trainer:
             dispatch += time.perf_counter() - ts
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
-            try:
-                epoch_len = len(self.train_dataloader) or 1
-            except TypeError:  # duck-typed iterable without __len__
-                epoch_len = 1 << 62
             if (
                 self.checkpointer is not None
                 and self.checkpoint_interval_batches
                 and self.batches_seen % self.checkpoint_interval_batches == 0
+            ):
+                try:
+                    epoch_len = len(self.train_dataloader) or 1
+                except TypeError:  # duck-typed iterable without __len__
+                    epoch_len = 1 << 62
+                snap = self._train_prefetcher.state_dict()
                 # the epoch-final batch is followed immediately by the
                 # epoch-end save — a snapshot there would be a throwaway
-                # full serialization of the same state
-                and self.batches_seen % epoch_len != 0
-            ):
-                # mid-epoch snapshot (sibling checkpointer): model/opt
-                # state + the consumer-true loader position, so a crash
-                # resumes with the very next batch (no replayed or
-                # skipped samples)
-                self._intra_checkpointer().save(
-                    self.state,
-                    meta={
-                        "epoch": self.epoch,
-                        "batches_seen": self.batches_seen,
-                        "samples_seen": self.samples_seen,
-                        "loader_state": self._train_prefetcher.state_dict(),
-                    },
-                )
+                # full serialization of the same state.  The WITHIN-epoch
+                # position decides (cumulative batches_seen desyncs from
+                # epoch boundaries after any mid-epoch stop).
+                if snap["batches_yielded"] < epoch_len:
+                    # mid-epoch snapshot (sibling checkpointer): model/opt
+                    # state + the consumer-true loader position, so a
+                    # crash resumes with the very next batch (no replayed
+                    # or skipped samples)
+                    self._intra_checkpointer().save(
+                        self.state,
+                        meta={
+                            "epoch": self.epoch,
+                            "batches_seen": self.batches_seen,
+                            "samples_seen": self.samples_seen,
+                            "loader_state": snap,
+                        },
+                    )
             # Accumulate on device (async) — floating every step would
             # block the host on each step's completion and serialize the
             # pipeline.
