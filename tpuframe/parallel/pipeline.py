@@ -12,6 +12,17 @@ fill the S-deep pipeline over M+S-1 ticks; reverse-mode AD through the
 
 Bubble fraction is (S-1)/(M+S-1) — choose ``n_microbatches >> stages``.
 
+Schedule choice (why GPipe + ``remat_stages`` rather than 1F1B): in this
+SPMD formulation the backward pipeline comes from reverse-mode through
+the tick scan, whose per-tick residuals with ``remat_stages=True`` are
+just each tick's stage *input* — activation memory O(M · micro · L · D)
+per device, the same order as non-pipelined rematerialized training.
+1F1B's win over that is only the M/S factor on the stash; buying it
+requires hand-scheduling interleaved forward/backward ticks under a
+custom VJP (manual pipeline backprop with an O(S) recompute buffer),
+whose complexity is not justified until profiling shows the stash —
+not the bubble — is the binding constraint on real configs.
+
 Two layers of API:
 
 - :func:`gpipe_spmd` — the schedule primitive: (stage_fn, stacked params,
