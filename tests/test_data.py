@@ -588,3 +588,25 @@ def test_loader_set_epoch_rewinds_position():
                          process_index=0, process_count=1)
     resumed.load_state_dict(sd)
     assert len(list(resumed)) == 4  # the whole epoch 1, nothing skipped
+
+
+def test_loader_state_dict_cross_rank_restore():
+    """The checkpoint meta is written once globally (by rank 0), so every
+    other rank must accept the snapshot and resume ITS OWN shard at the
+    same position — the fingerprint is rank-agnostic by design."""
+    ds = SyntheticImageDataset(n=32, image_size=2)
+    l0 = DataLoader(ds, batch_size=8, shuffle=True, seed=1,
+                    process_index=0, process_count=2)
+    it = iter(l0)
+    next(it)
+    snap = l0.state_dict()
+    assert "process_index" not in snap
+
+    l1 = DataLoader(ds, batch_size=8, shuffle=True, seed=1,
+                    process_index=1, process_count=2)
+    l1.load_state_dict(snap)  # rank 0's snapshot, rank 1's loader
+    rest = [lb.tolist() for _, lb in l1]
+    full = [lb.tolist() for _, lb in
+            DataLoader(ds, batch_size=8, shuffle=True, seed=1,
+                       process_index=1, process_count=2)]
+    assert rest == full[1:]  # rank 1's own shard, position preserved
