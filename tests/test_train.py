@@ -534,9 +534,11 @@ class TestMidEpochResume:
     @pytest.mark.slow
     def test_snapshots_isolated_from_epoch_checkpoints(self, tmp_path):
         """Mid-epoch snapshots live in a sibling dir with max_to_keep=1:
-        they never collide with or evict epoch-end checkpoints, and the
+        they never collide with or evict epoch-end checkpoints, the
         epoch-final batch is not snapshotted (the epoch-end save follows
-        immediately)."""
+        immediately), and a stale snapshot is deleted once a newer
+        epoch-end checkpoint supersedes it (r3 advisor: it would
+        otherwise linger on disk forever)."""
         from tpuframe.ckpt import Checkpointer
 
         ds = SyntheticImageDataset(n=128, image_size=28, channels=1,
@@ -558,5 +560,84 @@ class TestMidEpochResume:
         assert ck.all_steps() == [8]  # epoch-end only; no snapshot pollution
         _, meta = ck.restore(trainer.state)
         assert meta["epoch"] == 1 and "loader_state" not in meta
+        # snapshots 2 and 4 were superseded mid-epoch (max_to_keep=1),
+        # batch 8's was skipped (epoch-final), and batch 6's was deleted
+        # by the newer epoch-end save at step 8
         intra = Checkpointer(str(tmp_path / "ck2") + "_intra")
-        assert intra.all_steps() == [6]  # max_to_keep=1; final batch skipped
+        assert intra.all_steps() == []
+
+    @pytest.mark.slow
+    def test_leftover_snapshot_resumes_even_with_feature_off(self, tmp_path):
+        """A crash mid-epoch leaves an _intra snapshot; a restart that
+        DISABLES checkpoint_interval_batches must still auto-resume from
+        it (r3 advisor: the old gate silently replayed from the older
+        epoch-end checkpoint)."""
+        from tpuframe.ckpt import Checkpointer
+        from tpuframe.train.callbacks import Callback
+
+        def make(interval):
+            ds = SyntheticImageDataset(n=128, image_size=28, channels=1,
+                                       num_classes=4)
+            lt = DataLoader(ds, batch_size=16, shuffle=True, seed=5,
+                            process_index=0, process_count=1)
+            return Trainer(
+                MnistNet(num_classes=4),
+                train_dataloader=lt,
+                max_duration="8ba",
+                lr=1e-3,
+                num_classes=4,
+                log_interval=0,
+                checkpointer=Checkpointer(tmp_path / "ck3"),
+                checkpoint_interval_batches=interval,
+            )
+
+        class Bomb(Callback):
+            def on_step_end(self, trainer, *a):
+                if trainer.batches_seen >= 5:
+                    raise RuntimeError("boom")
+
+        first = make(interval=3)
+        first.callbacks = [Bomb()]
+        with pytest.raises(RuntimeError, match="boom"):
+            first.fit()
+
+        resumed = make(interval=None)  # feature off on the restart
+        resumed.fit()
+        # restored at batches_seen=3 (the snapshot), not 0: only batches
+        # 4..8 were retrained
+        assert resumed.batches_seen == 8
+        assert int(resumed.state.step) == 8
+
+    def test_untrackable_loader_with_mid_epoch_ckpt_is_a_clear_error(
+        self, tmp_path
+    ):
+        """checkpoint_interval_batches + a duck-typed iterable without
+        state_dict() must raise a curated error, not AttributeError deep
+        in the prefetcher (r3 advisor, medium)."""
+        from tpuframe.ckpt import Checkpointer
+
+        class Duck:
+            global_batch_size = 16
+            process_count = 1
+
+            def set_epoch(self, e):
+                pass
+
+            def __iter__(self):
+                rng = np.random.default_rng(0)
+                for _ in range(4):
+                    yield (rng.standard_normal((16, 28, 28, 1)).astype(np.float32),
+                           rng.integers(0, 4, (16,)).astype(np.int32))
+
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=Duck(),
+            max_duration="1ep",
+            num_classes=4,
+            log_interval=0,
+            sample_input=np.zeros((1, 28, 28, 1), np.float32),
+            checkpointer=Checkpointer(tmp_path / "ck4"),
+            checkpoint_interval_batches=2,
+        )
+        with pytest.raises(ValueError, match="checkpoint_interval_batches"):
+            trainer.fit()

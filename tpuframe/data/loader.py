@@ -114,8 +114,12 @@ class DataLoader:
         self.num_workers = num_workers
         self.worker_mode = worker_mode
         self._proc_pool = None
-        self._epoch = 0
-        self._batches_yielded = 0  # within the current epoch (resume point)
+        # (epoch, batches_yielded) as ONE tuple: the position is read from
+        # the DevicePrefetcher's background thread while set_epoch /
+        # load_state_dict may run on the main thread, and a single
+        # attribute assignment is atomic under the GIL — two separate
+        # attributes could be observed torn (new epoch, old position).
+        self._pos = (0, 0)
         self._resume_offset = 0  # batches to skip on the next __iter__
         if num_workers and worker_mode == "process":
             # Fork NOW, from the constructing (main) thread — a lazy fork
@@ -143,11 +147,19 @@ class DataLoader:
         "epoch e, nothing consumed", not the previous epoch's end.
         (``load_state_dict`` re-applies its offset after calling this.)
         """
-        self._epoch = int(epoch)
-        self._batches_yielded = 0
+        self._pos = (int(epoch), 0)
         self._resume_offset = 0
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
+
+    @property
+    def _epoch(self) -> int:
+        return self._pos[0]
+
+    @property
+    def _batches_yielded(self) -> int:
+        """Within the current epoch (the resume point)."""
+        return self._pos[1]
 
     def state_dict(self) -> dict:
         """Deterministic mid-epoch resume point (mosaicml-streaming's
@@ -170,9 +182,10 @@ class DataLoader:
         # process consumes the same batch count in lockstep), so rank 0's
         # snapshot must restore cleanly on every other process (the
         # checkpoint meta is written once, globally)
+        epoch, batches = self._pos  # one read: epoch/position stay paired
         return {
-            "epoch": self._epoch,
-            "batches_yielded": self._batches_yielded,
+            "epoch": epoch,
+            "batches_yielded": batches,
             "global_batch_size": self.global_batch_size,
             "process_count": self.process_count,
             "dataset_len": len(self.dataset),
@@ -214,7 +227,7 @@ class DataLoader:
             )
         self.set_epoch(int(state["epoch"]))
         self._resume_offset = offset
-        self._batches_yielded = offset
+        self._pos = (int(state["epoch"]), offset)
 
     def _per_process_count(self) -> int:
         n = len(self.dataset)
@@ -222,12 +235,15 @@ class DataLoader:
             return n // self.process_count + 1
         return n // self.process_count
 
-    def _indices(self) -> tuple[np.ndarray, np.ndarray]:
-        """This process's (indices, genuine) — genuine=False marks wrap-pad
-        duplicates added only to equalize per-process counts."""
+    def _indices(self, epoch: int) -> tuple[np.ndarray, np.ndarray]:
+        """This process's (indices, genuine) for ``epoch`` — genuine=False
+        marks wrap-pad duplicates added only to equalize per-process
+        counts.  Takes the epoch explicitly so ``__iter__``'s captured
+        epoch seeds the permutation AND tags every position write — one
+        consistent epoch even if set_epoch races on another thread."""
         n = len(self.dataset)
         order = (
-            np.random.default_rng(self.seed * 1_000_003 + self._epoch).permutation(n)
+            np.random.default_rng(self.seed * 1_000_003 + epoch).permutation(n)
             if self.shuffle
             else np.arange(n)
         )
@@ -277,7 +293,11 @@ class DataLoader:
             pass
 
     def __iter__(self) -> Iterator[tuple]:
-        indices, genuine = self._indices()
+        # the generator captures ITS epoch once and pairs it with every
+        # position write — a concurrent set_epoch on another thread can
+        # replace _pos wholesale but never produce a mixed pair
+        epoch = self._epoch
+        indices, genuine = self._indices(epoch)
         nb_full = len(indices) // self.local_batch_size
         tail = len(indices) % self.local_batch_size
 
@@ -286,7 +306,6 @@ class DataLoader:
             # chunked map: one IPC round per worker-chunk, not per item
             ppool = self._process_pool()
             chunk = max(1, self.local_batch_size // (self.num_workers * 2))
-            epoch = self._epoch
             fetch = lambda idxs: ppool.map(  # noqa: E731
                 _pool_get, [(int(i), epoch) for i in idxs], chunksize=chunk
             )
@@ -304,7 +323,7 @@ class DataLoader:
         # skipped samples is needed); a fresh epoch starts at 0
         start = min(self._resume_offset, len(self))
         self._resume_offset = 0
-        self._batches_yielded = start
+        self._pos = (epoch, start)
         try:
             for b in range(start, nb_full):
                 sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)
@@ -314,7 +333,7 @@ class DataLoader:
                 # count BEFORE the yield: a generator suspends AT the
                 # yield, so a post-yield update would lag one batch behind
                 # what the caller has already consumed
-                self._batches_yielded = b + 1
+                self._pos = (epoch, b + 1)
                 if self.drop_last:
                     yield images, labels
                 else:
@@ -328,7 +347,7 @@ class DataLoader:
                     [lb for _, lb in items] + [items[-1][1]] * pad, np.int32
                 )
                 valid = np.concatenate([genuine[sl], np.zeros(pad, bool)])
-                self._batches_yielded = nb_full + 1
+                self._pos = (epoch, nb_full + 1)
                 yield images, labels, valid
         finally:
             if pool:

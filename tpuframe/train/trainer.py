@@ -304,14 +304,19 @@ class Trainer:
         otherwise collide).  Only the most recent snapshot matters for
         crash-resume, so one is kept.
         """
-        if self._intra_ck is None and (
-            self.checkpointer is not None and self.checkpoint_interval_batches
-        ):
+        if self._intra_ck is None and self.checkpointer is not None:
             from tpuframe.ckpt import Checkpointer
+            from tpuframe.ckpt.checkpoint import latest_step
 
-            self._intra_ck = Checkpointer(
-                str(self.checkpointer.directory) + "_intra", max_to_keep=1
-            )
+            intra_dir = str(self.checkpointer.directory) + "_intra"
+            # Construct when the feature is on, OR when a previous run
+            # (that had it on) left a snapshot behind — auto-resume must
+            # see that snapshot even if this run disabled the feature,
+            # else a restart silently replays from an older epoch-end
+            # checkpoint.  The path probe avoids creating the directory
+            # just to look.
+            if self.checkpoint_interval_batches or latest_step(intra_dir) is not None:
+                self._intra_ck = Checkpointer(intra_dir, max_to_keep=1)
         return self._intra_ck
 
     def _emit(self, hook: str, *args) -> None:
@@ -401,12 +406,22 @@ class Trainer:
                     out = {k: split_micro(v) for k, v in out.items()}
                 yield out
 
+        # consumer-true resume position for mid-epoch checkpoints (the
+        # loader's own counter runs `depth` batches ahead).  Duck-typed
+        # train iterables without state_dict() are fine — they just can't
+        # be position-tracked, so mid-epoch checkpointing must be off.
+        trackable = hasattr(loader, "state_dict")
+        if train and self.checkpoint_interval_batches and not trackable:
+            raise ValueError(
+                "checkpoint_interval_batches (mid-epoch snapshots) requires "
+                "a train_dataloader with state_dict()/load_state_dict() "
+                f"(got {type(loader).__name__}); use tpuframe.data.DataLoader "
+                "or disable checkpoint_interval_batches"
+            )
         pf = DevicePrefetcher(
             host_iter(),
             sharding=self.plan.batch_sharding(leading_microbatch=accum > 1),
-            # consumer-true resume position for mid-epoch checkpoints (the
-            # loader's own counter runs `depth` batches ahead)
-            track_loader=loader if train else None,
+            track_loader=loader if train and trackable else None,
         )
         if train:
             self._train_prefetcher = pf
@@ -486,6 +501,20 @@ class Trainer:
                         },
                     )
                     result.checkpoint = str(ckpt_path)
+                    # An epoch-end save supersedes any mid-epoch snapshot
+                    # at an earlier-or-equal optimizer step: drop it so it
+                    # neither lingers on disk nor wins a later auto-resume
+                    # it no longer should.
+                    intra = self._intra_checkpointer()
+                    if intra is not None:
+                        saved = self.checkpointer.latest_step()
+                        stale = intra.latest_step()
+                        if (
+                            saved is not None
+                            and stale is not None
+                            and stale <= saved
+                        ):
+                            intra.delete(stale)
                 if self.report is not None:
                     self.report(epoch_summary, result.checkpoint)
                 self.epoch += 1
@@ -515,6 +544,17 @@ class Trainer:
         if self._pending_loader_state is not None:
             # resume mid-epoch: skip the already-trained batches of this
             # epoch (this epoch's summary then covers only the remainder)
+            if not hasattr(self.train_dataloader, "load_state_dict"):
+                # a leftover snapshot from a previous run can reach here
+                # even with checkpoint_interval_batches off; silently
+                # dropping the position would replay trained batches
+                raise ValueError(
+                    "resuming a mid-epoch snapshot requires a "
+                    "train_dataloader with load_state_dict() (got "
+                    f"{type(self.train_dataloader).__name__}); restore "
+                    "with a tpuframe.data.DataLoader or delete the "
+                    "*_intra snapshot directory"
+                )
             self.train_dataloader.load_state_dict(self._pending_loader_state)
             self._pending_loader_state = None
         acc = None
