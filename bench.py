@@ -209,8 +209,7 @@ def _run_bench() -> None:
     # (jit's call path would not share the AOT cache — compiling twice
     # costs minutes).  Cost analysis reports the FLOPs of the *per-device*
     # partitioned program; best-effort (some PJRT plugins omit it), with
-    # the standard analytic ResNet50 count as fallback (~4.09 GFLOP
-    # forward/image at 224px, x3 for fwd+bwd, divided over chips).
+    # the analytic ResNet50 count below as fallback.
     compiled = step_fn.lower(state, data).compile()
     flops_per_dev_step, bytes_per_dev_step = cost_analysis(compiled)
     # FLOP convention (stated once, used everywhere): 2 FLOP per MAC —

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# One-pass capture of every on-chip proof artifact into benchmarks/results/.
+# Run whenever the TPU tunnel is live; each step is independently timed out
+# so one wedge doesn't lose the rest.  Artifacts are committed JSON — the
+# round's evidence that the kernel/offload paths ran on real Mosaic, not
+# CPU interpret (VERDICT r03 weak #3/#4).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+
+run() { # name, timeout_s, cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ==="
+  timeout "$tmo" "$@" > "benchmarks/results/$name.json" 2> "benchmarks/results/$name.err"
+  local rc=$?
+  echo "rc=$rc"; tail -c 400 "benchmarks/results/$name.json"; echo
+}
+
+run bench_live          600  python bench.py
+run check_kernels_tpu   900  python benchmarks/check_kernels_tpu.py
+run check_offload_tpu   600  python benchmarks/check_offload_tpu.py
+echo "done; inspect benchmarks/results/"

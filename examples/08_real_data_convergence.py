@@ -1,0 +1,212 @@
+"""Real-data convergence recipes — the accuracy half of the north star.
+
+The reference validates by watching per-epoch test accuracy
+(`/root/reference/02_deepspeed/02_tiny_imagenet_deepspeed_resnet.py:219-297`);
+this example is that loop as a committed, asserted recipe through the
+full Trainer: augmentation, linear-warmup + cosine schedule,
+checkpointing with auto-resume, per-epoch held-out eval, and a
+``--min-accuracy`` acceptance gate (exit 1 below threshold).
+
+Two datasets:
+
+- ``--dataset digits`` (default): sklearn's bundled 1,797 real scanned
+  handwritten digits — the largest real image dataset available in a
+  zero-egress sandbox.  Target >= 97% held-out top-1 (published small-CNN
+  ballpark for this dataset is ~98-99%; the committed run reaches 98.7%
+  on CPU in ~1 min, see PERF.md).
+- ``--dataset cifar10``: the from-scratch ResNet18 >= 90% CIFAR-10 recipe
+  (RandomCrop+flip, bf16 on TPU, SGD momentum + warmup-cosine, label
+  smoothing).  Needs real CIFAR-10 on disk: pass ``--data-npz`` with
+  arrays ``x_train/y_train/x_test/y_test`` (uint8 HWC), or have the HF
+  cache populated for ``hfds_download("cifar10")``.  In this sandbox
+  neither exists (no egress), so the recipe exits with a clear message
+  unless data is supplied — run it on any machine with the data to
+  reproduce the 90%+ number.
+
+Run:  python 08_real_data_convergence.py --dataset digits --epochs 25 \
+          --min-accuracy 0.97 --workdir /tmp/digits
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import _common  # noqa: F401  (repo-root sys.path setup)
+from tpuframe import core
+from tpuframe.ckpt import Checkpointer
+from tpuframe.data import ArrayDataset, DataLoader
+from tpuframe.models import MnistNet, ResNet18
+from tpuframe.train import LabelSmoothing, Trainer, warmup_cosine
+
+
+def load_digits_arrays(n_train: int = 1500, seed: int = 0):
+    """sklearn digits -> bilinear-upscaled 28x28x1 floats in [0, 1]."""
+    from PIL import Image
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    X = digits.images.astype(np.float32)  # (1797, 8, 8), values 0..16
+    y = digits.target.astype(np.int32)
+    order = np.random.default_rng(seed).permutation(len(X))
+    X, y = X[order], y[order]
+
+    def up(a: np.ndarray) -> np.ndarray:
+        img = Image.fromarray((a * (255.0 / 16.0)).astype(np.uint8))
+        img = img.resize((28, 28), Image.BILINEAR)
+        return (np.asarray(img, np.float32) / 255.0)[..., None]
+
+    X = np.stack([up(x) for x in X])
+    return (X[:n_train], y[:n_train]), (X[n_train:], y[n_train:])
+
+
+def shift_crop(pad: int, size: int):
+    """RandomCrop(size, padding=pad) — the CIFAR augmentation idiom."""
+
+    def aug(img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        p = np.pad(img, ((pad, pad), (pad, pad), (0, 0)))
+        dy, dx = rng.integers(0, 2 * pad + 1, 2)
+        return p[dy : dy + size, dx : dx + size]
+
+    return aug
+
+
+def flip_and_crop(pad: int, size: int):
+    crop = shift_crop(pad, size)
+
+    def aug(img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        img = crop(img, rng)
+        return img[:, ::-1] if rng.random() < 0.5 else img
+
+    return aug
+
+
+def train_digits(args) -> float:
+    (xtr, ytr), (xte, yte) = load_digits_arrays()
+    lt = DataLoader(
+        ArrayDataset(xtr, ytr, transform=shift_crop(2, 28)),
+        batch_size=96, shuffle=True, seed=args.seed,
+    )
+    le = DataLoader(ArrayDataset(xte, yte), batch_size=96, drop_last=False)
+    steps = args.epochs * len(lt)
+    trainer = Trainer(
+        MnistNet(num_classes=10),
+        train_dataloader=lt,
+        eval_dataloader=le,
+        max_duration=f"{args.epochs}ep",
+        optimizer="adamw",
+        lr=warmup_cosine(2e-3, warmup_steps=len(lt), total_steps=steps),
+        num_classes=10,
+        log_interval=0,
+        eval_interval=args.eval_interval,
+        checkpointer=Checkpointer(
+            os.path.join(args.workdir, "ck"), best_metric="eval_accuracy",
+            best_mode="max",
+        ),
+        seed=args.seed,
+    )
+    result = trainer.fit()
+    for e, h in enumerate(result.history):
+        if "eval_accuracy" in h:
+            print(f"epoch {e + 1}: eval_accuracy={h['eval_accuracy']:.4f}")
+    return float(result.metrics["eval_accuracy"])
+
+
+def load_cifar10_arrays(args):
+    if args.data_npz:
+        blob = np.load(args.data_npz)
+        return (
+            (blob["x_train"], blob["y_train"].astype(np.int32)),
+            (blob["x_test"], blob["y_test"].astype(np.int32)),
+        )
+    from tpuframe.data import hfds_download
+
+    ds = hfds_download("cifar10", os.path.join(args.workdir, "hf_cache"))
+    to_np = lambda split: (  # noqa: E731
+        np.stack([np.asarray(im) for im in split["img"]]),
+        np.asarray(split["label"], np.int32),
+    )
+    return to_np(ds["train"]), to_np(ds["test"])
+
+
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def train_cifar10(args) -> float:
+    (xtr, ytr), (xte, yte) = load_cifar10_arrays(args)
+    norm = lambda x: (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD  # noqa: E731
+    xtr, xte = norm(xtr), norm(xte)
+    lt = DataLoader(
+        ArrayDataset(xtr, ytr, transform=flip_and_crop(4, 32)),
+        batch_size=args.batch_size, shuffle=True, seed=args.seed,
+    )
+    le = DataLoader(ArrayDataset(xte, yte), batch_size=args.batch_size,
+                    drop_last=False)
+    steps = args.epochs * len(lt)
+    rt = core.initialize()
+    trainer = Trainer(
+        ResNet18(num_classes=10, stem="cifar"),
+        train_dataloader=lt,
+        eval_dataloader=le,
+        max_duration=f"{args.epochs}ep",
+        optimizer="sgd",
+        lr=warmup_cosine(
+            0.1 * args.batch_size / 128, warmup_steps=5 * len(lt),
+            total_steps=steps,
+        ),
+        algorithms=[LabelSmoothing(0.1, num_classes=10)],
+        precision="bf16" if rt.platform == "tpu" else "f32",
+        num_classes=10,
+        log_interval=0,
+        eval_interval=args.eval_interval,
+        checkpointer=Checkpointer(
+            os.path.join(args.workdir, "ck"), best_metric="eval_accuracy",
+            best_mode="max",
+        ),
+        seed=args.seed,
+    )
+    result = trainer.fit()
+    for e, h in enumerate(result.history):
+        if "eval_accuracy" in h:
+            print(f"epoch {e + 1}: eval_accuracy={h['eval_accuracy']:.4f}")
+    return float(result.metrics["eval_accuracy"])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dataset", choices=["digits", "cifar10"], default="digits")
+    ap.add_argument("--epochs", type=int, default=25)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--eval-interval", type=int, default=5)
+    ap.add_argument("--min-accuracy", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default="/tmp/tpuframe_convergence")
+    ap.add_argument("--data-npz", default=None,
+                    help="cifar10 arrays: x_train/y_train/x_test/y_test")
+    args = ap.parse_args()
+    os.makedirs(args.workdir, exist_ok=True)
+
+    if args.dataset == "digits":
+        acc = train_digits(args)
+    else:
+        try:
+            acc = train_cifar10(args)
+        except RuntimeError as e:
+            print(f"cifar10 data unavailable: {e}", file=sys.stderr)
+            sys.exit(2)
+    print(f"final eval_accuracy={acc:.4f}")
+    if args.min_accuracy is not None:
+        if acc < args.min_accuracy:
+            print(f"REJECTED: {acc:.4f} < {args.min_accuracy}")
+            sys.exit(1)
+        print(f"ACCEPTED: {acc:.4f} >= {args.min_accuracy}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Generate the tiny committed MosaicML-MDS fixture (tests/fixtures/mds_tiny*).
+
+Writes the public MDS on-disk layout (index.json version 2 + shard files:
+``uint32 n | uint32 offsets[n+1] | samples``; per-sample ``uint32`` widths
+for variable columns then column bytes; 'pil' = uint32[3](w,h,len(mode)) +
+mode + raw pixels, 'int' = int64 LE) with the reference's column schema
+``{'image': 'pil', 'label': 'int'}`` and zstd compression — the exact shape
+``MDSWriter`` produces in
+`/root/reference/01_torch_distributor/03a_tiny_imagenet_torch_distributor_resnet_mds.py:180-224`.
+
+Deliberately independent of tpuframe.data.mds (the reader under test):
+this is a from-the-spec writer so the committed bytes exercise the reader
+rather than mirroring it.  Deterministic — rerunning reproduces the same
+bytes (useful if the fixture ever needs regeneration).
+
+Usage: python tests/fixtures/make_mds_fixture.py
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def deterministic_image(i: int, size: int = 6) -> np.ndarray:
+    """RGB uint8 image whose pixels are a pure function of ``i``."""
+    rng = np.random.default_rng(1000 + i)
+    return rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+
+
+def encode_pil(arr: np.ndarray) -> bytes:
+    from PIL import Image
+
+    img = Image.fromarray(arr)  # mode "RGB"
+    mode = img.mode.encode("utf-8")
+    w, h = img.size
+    return struct.pack("<III", w, h, len(mode)) + mode + img.tobytes()
+
+
+def encode_sample(image: np.ndarray, label: int) -> bytes:
+    # columns in order: image (pil, variable), label (int, fixed 8 bytes)
+    img_bytes = encode_pil(image)
+    head = struct.pack("<I", len(img_bytes))  # one uint32 per variable col
+    return head + img_bytes + np.int64(label).tobytes()
+
+
+def write_shard(samples: list[bytes]) -> bytes:
+    n = len(samples)
+    header = 4 + 4 * (n + 1)
+    offsets = np.zeros(n + 1, dtype="<u4")
+    offsets[0] = header
+    for i, s in enumerate(samples):
+        offsets[i + 1] = offsets[i] + len(s)
+    return struct.pack("<I", n) + offsets.tobytes() + b"".join(samples)
+
+
+def shard_entry(raw: bytes, basename: str, n: int, compression: str | None):
+    entry = {
+        "column_encodings": ["pil", "int"],
+        "column_names": ["image", "label"],
+        "column_sizes": [None, 8],
+        "compression": compression,
+        "format": "mds",
+        "hashes": [],
+        "raw_data": {"basename": basename, "bytes": len(raw), "hashes": {}},
+        "samples": n,
+        "size_limit": 1 << 26,
+        "version": 2,
+        "zip_data": None,
+    }
+    return entry
+
+
+def main() -> None:
+    import zstandard
+
+    # --- mds_tiny: 2 zstd-compressed shards, 5 + 3 samples -------------
+    out = os.path.join(HERE, "mds_tiny")
+    os.makedirs(out, exist_ok=True)
+    entries = []
+    counts = [5, 3]
+    idx = 0
+    for si, n in enumerate(counts):
+        samples = []
+        for _ in range(n):
+            samples.append(encode_sample(deterministic_image(idx), idx % 4))
+            idx += 1
+        raw = write_shard(samples)
+        basename = f"shard.{si:05d}.mds"
+        zip_name = basename + ".zstd"
+        comp = zstandard.ZstdCompressor(level=3).compress(raw)
+        with open(os.path.join(out, zip_name), "wb") as f:
+            f.write(comp)
+        entry = shard_entry(raw, basename, n, "zstd:3")
+        entry["zip_data"] = {
+            "basename": zip_name,
+            "bytes": len(comp),
+            "hashes": {},
+        }
+        entries.append(entry)
+    with open(os.path.join(out, "index.json"), "w") as f:
+        json.dump({"shards": entries, "version": 2}, f, indent=1, sort_keys=True)
+    print(f"wrote {out}: {idx} samples, {len(entries)} zstd shards")
+
+    # --- mds_tiny_raw: 1 uncompressed shard, 4 samples -----------------
+    out = os.path.join(HERE, "mds_tiny_raw")
+    os.makedirs(out, exist_ok=True)
+    samples = [encode_sample(deterministic_image(100 + i), i) for i in range(4)]
+    raw = write_shard(samples)
+    basename = "shard.00000.mds"
+    with open(os.path.join(out, basename), "wb") as f:
+        f.write(raw)
+    with open(os.path.join(out, "index.json"), "w") as f:
+        json.dump(
+            {"shards": [shard_entry(raw, basename, 4, None)], "version": 2},
+            f,
+            indent=1,
+            sort_keys=True,
+        )
+    print(f"wrote {out}: 4 samples, 1 raw shard")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Generate the committed torchvision-format ResNet18 fixture.
+
+Builds a width-4 ResNet18 in plain torch with torchvision's exact module
+names and semantics (BasicBlock layout, 7x7/s2 + maxpool stem, symmetric
+padding, eval-mode BN with running stats), then commits:
+
+- ``resnet18_tv_w4.pt``        — ``torch.save``'d state_dict, the same
+  file shape a user gets from ``torch.save(resnet18(weights=...).
+  state_dict(), path)`` (reference transfer path,
+  `/root/reference/01_torch_distributor/02_cifar_torch_distributor_resnet.py:146`),
+  just width-4 so the fixture stays ~200 KB instead of 45 MB.
+- ``resnet18_tv_w4_golden.npz`` — a fixed input batch and the torch
+  model's eval-mode logits for it: the import test replays these through
+  the flax model, proving numerical parity end to end WITHOUT needing
+  torch at test time.
+
+Deterministic (seeded); rerunning reproduces the fixture.
+
+Usage: python tests/fixtures/make_torch_resnet_fixture.py
+"""
+
+import os
+
+import numpy as np
+import torch
+from torch import nn
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WIDTH = 4
+NUM_CLASSES = 10
+
+
+class BasicBlock(nn.Module):
+    """torchvision-semantics BasicBlock (3x3/s + 3x3/1, projection skip)."""
+
+    def __init__(self, in_planes: int, planes: int, stride: int = 1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_planes, planes, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = None
+        if stride != 1 or in_planes != planes:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(in_planes, planes, 1, stride, bias=False),
+                nn.BatchNorm2d(planes),
+            )
+
+    def forward(self, x):
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        identity = x if self.downsample is None else self.downsample(x)
+        return torch.relu(out + identity)
+
+
+class TorchResNet18(nn.Module):
+    """ResNet18 with torchvision's exact state_dict key names."""
+
+    def __init__(self, width: int = 64, num_classes: int = 1000):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, width, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        planes = [width, width * 2, width * 4, width * 8]
+        in_planes = width
+        for i, p in enumerate(planes):
+            stride = 1 if i == 0 else 2
+            layer = nn.Sequential(
+                BasicBlock(in_planes, p, stride), BasicBlock(p, p, 1)
+            )
+            setattr(self, f"layer{i + 1}", layer)
+            in_planes = p
+        self.avgpool = nn.AdaptiveAvgPool2d((1, 1))
+        self.fc = nn.Linear(width * 8, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        for i in range(1, 5):
+            x = getattr(self, f"layer{i}")(x)
+        x = self.avgpool(x).flatten(1)
+        return self.fc(x)
+
+
+def main() -> None:
+    torch.manual_seed(7)
+    model = TorchResNet18(width=WIDTH, num_classes=NUM_CLASSES)
+    # Non-trivial BN running stats: a fresh model's mean=0/var=1 would let
+    # a swapped mean<->var (or scale<->bias) mapping pass undetected.
+    with torch.no_grad():
+        for mod in model.modules():
+            if isinstance(mod, nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.5, 0.5)
+                mod.running_var.uniform_(0.5, 2.0)
+    model.eval()
+
+    sd_path = os.path.join(HERE, "resnet18_tv_w4.pt")
+    torch.save(model.state_dict(), sd_path)
+
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)  # NHWC
+    with torch.no_grad():
+        logits = model(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.savez(os.path.join(HERE, "resnet18_tv_w4_golden.npz"), x=x, logits=logits)
+
+    n_params = sum(p.numel() for p in model.parameters())
+    print(
+        f"wrote {sd_path} ({os.path.getsize(sd_path) / 1024:.0f} KiB, "
+        f"{n_params} params) + golden logits {logits.shape}"
+    )
+
+
+if __name__ == "__main__":
+    main()

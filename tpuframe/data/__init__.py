@@ -16,6 +16,7 @@ from tpuframe.data.datasets import (
     make_image_dataset,
 )
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
+from tpuframe.data.mds import MDSDataset, mds_to_tfs
 from tpuframe.data.streaming import ShardWriter, StreamingDataset, clean_stale_cache
 from tpuframe.data.transforms import (
     CenterCrop,
@@ -38,6 +39,8 @@ __all__ = [
     "make_image_dataset",
     "DataLoader",
     "DevicePrefetcher",
+    "MDSDataset",
+    "mds_to_tfs",
     "ShardWriter",
     "StreamingDataset",
     "clean_stale_cache",

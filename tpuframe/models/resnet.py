@@ -143,13 +143,23 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        conv = functools.partial(
-            nn.Conv,
-            use_bias=False,
-            dtype=self.dtype,
-            padding="SAME",
-            kernel_init=nn.initializers.he_normal(),
-        )
+        def conv(features, kernel_size, strides=(1, 1), name=None):
+            # torch-symmetric half padding (Conv2d's padding=k//2), NOT
+            # "SAME": identical for stride 1, but SAME pads stride-2 convs
+            # asymmetrically ((2,3) for the 7x7/s2 stem), which silently
+            # shifts every window of an imported torchvision checkpoint.
+            # Shapes match SAME for even inputs, so this costs nothing and
+            # makes interop.import_torch_resnet numerically exact.
+            return nn.Conv(
+                features,
+                kernel_size,
+                strides,
+                use_bias=False,
+                dtype=self.dtype,
+                padding=tuple((k // 2, k // 2) for k in kernel_size),
+                kernel_init=nn.initializers.he_normal(),
+                name=name,
+            )
         # stats/affine math stays f32 either way (flax promotes inside);
         # norm_dtype only picks the OUTPUT dtype of the normalize
         bn_out_dtype = self.norm_dtype if self.norm_dtype is not None else jnp.float32

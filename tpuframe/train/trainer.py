@@ -411,7 +411,12 @@ class Trainer:
         # train iterables without state_dict() are fine — they just can't
         # be position-tracked, so mid-epoch checkpointing must be off.
         trackable = hasattr(loader, "state_dict")
-        if train and self.checkpoint_interval_batches and not trackable:
+        if (
+            train
+            and self.checkpointer is not None
+            and self.checkpoint_interval_batches
+            and not trackable
+        ):
             raise ValueError(
                 "checkpoint_interval_batches (mid-epoch snapshots) requires "
                 "a train_dataloader with state_dict()/load_state_dict() "
