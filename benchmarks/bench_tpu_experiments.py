@@ -10,6 +10,10 @@ several configurations and prints one JSON line per config:
                 BN->relu->conv activation traffic (PERF.md headroom item)
   batch_256     baseline at batch 256 (sweep point)
   bn_bf16_b256  both
+  bn_bf16_b512  bn_bf16 at batch 512 (r04 sweep point)
+  uint8_in      uint8 images + fused on-device normalize to bf16 (raw
+                bytes over PCIe; no f32 image tensor ever on chip)
+  uint8_in_b256 uint8_in at batch 256
 
 Each record carries img/s, MFU, and XLA cost-analysis bytes so PERF.md's
 roofline table can attribute the delta.  Safe to re-run: the persistent
@@ -33,6 +37,15 @@ CONFIGS = {
     "bn_bf16": dict(batch=128, norm_bf16=True),
     "batch_256": dict(batch=256, norm_bf16=False),
     "bn_bf16_b256": dict(batch=256, norm_bf16=True),
+    # r04 headroom sweep (VERDICT r03 #8): batch scaling beyond 256,
+    # uint8 input + fused on-device normalize (cuts the input tensor's
+    # HBM write+read from f32 to bytes), and both together.  For the
+    # XLA latency-hiding scheduler A/B, re-run any config under
+    #   XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true"
+    # (must be set before jax initializes — not toggleable in-process).
+    "bn_bf16_b512": dict(batch=512, norm_bf16=True),
+    "uint8_in": dict(batch=128, norm_bf16=True, uint8_input=True),
+    "uint8_in_b256": dict(batch=256, norm_bf16=True, uint8_input=True),
 }
 
 
@@ -65,20 +78,45 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
         init_kwargs={"train": False},
     )
     rng = np.random.default_rng(0)
+    uint8_input = bool(cfg.get("uint8_input"))
+    if uint8_input:
+        images = rng.integers(0, 256, (cfg["batch"], 224, 224, 3), dtype=np.uint8)
+    else:
+        images = rng.standard_normal((cfg["batch"], 224, 224, 3)).astype(np.float32)
     batch = plan.shard_batch(
         {
-            "image": rng.standard_normal((cfg["batch"], 224, 224, 3)).astype(
-                np.float32
-            ),
+            "image": images,
             "label": rng.integers(0, 1000, (cfg["batch"],)).astype(np.int32),
         }
     )
+    batch_transform = None
+    if uint8_input:
+        from tpuframe.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+        from tpuframe.ops import normalize_images
+
+        def batch_transform(b: dict) -> dict:
+            # raw bytes ride host->HBM; the fused Pallas normalize emits
+            # bf16 directly, so no f32 image tensor ever exists on chip.
+            # mesh/batch_axes shard the kernel like the trainer's own
+            # normalize path (trainer.py) — without them GSPMD would
+            # gather the full batch onto every chip and skew the A/B.
+            b["image"] = normalize_images(
+                b["image"], IMAGENET_MEAN, IMAGENET_STD,
+                out_dtype=jnp.bfloat16,
+                mesh=plan.mesh, batch_axes=tuple(plan.data_axes),
+            )
+            return b
+
     # bench.py owns the measurement methodology (timing windows, cost
     # analysis, device-kind peak table); a silent CPU fallback must be
     # visible in the record, not attributed to the chip (BENCH_r02 lesson)
     import bench as headline_bench
 
-    compiled = make_train_step(policy).lower(state, batch).compile()
+    compiled = (
+        make_train_step(policy, batch_transform=batch_transform)
+        .lower(state, batch)
+        .compile()
+    )
     flops, bytes_accessed = headline_bench.cost_analysis(compiled)
     img_s, state, _metrics = headline_bench.time_train_step(
         compiled, state, batch, batch=cfg["batch"], steps=steps
