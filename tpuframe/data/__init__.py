@@ -16,7 +16,7 @@ from tpuframe.data.datasets import (
     make_image_dataset,
 )
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
-from tpuframe.data.mds import MDSDataset, mds_to_tfs
+from tpuframe.data.mds import MDSDataset, MDSWriter, mds_to_tfs
 from tpuframe.data.streaming import ShardWriter, StreamingDataset, clean_stale_cache
 from tpuframe.data.transforms import (
     CenterCrop,
@@ -40,6 +40,7 @@ __all__ = [
     "DataLoader",
     "DevicePrefetcher",
     "MDSDataset",
+    "MDSWriter",
     "mds_to_tfs",
     "ShardWriter",
     "StreamingDataset",

@@ -1,4 +1,4 @@
-"""Read-only MosaicML MDS shard interop.
+"""MosaicML MDS shard interop: read existing volumes, write compatible ones.
 
 A reference user's existing MDS volumes — written by ``MDSWriter`` as in
 `/root/reference/01_torch_distributor/03a_tiny_imagenet_torch_distributor_resnet_mds.py:180-224`
@@ -6,6 +6,9 @@ A reference user's existing MDS volumes — written by ``MDSWriter`` as in
 can be consumed directly by :class:`MDSDataset` (a drop-in map-style
 dataset for :class:`tpuframe.data.DataLoader`) or converted once with
 :func:`mds_to_tfs` into tpuframe's native TFS shard format.
+:class:`MDSWriter` is the write half: it produces volumes in the same
+on-disk layout, so data prepared on a TPU pipeline remains consumable by
+mosaicml-streaming loaders (the inverse migration).
 
 This implements the public MDS on-disk layout (mosaicml-streaming's
 ``format/mds``, Apache-2.0; re-implemented from the format, not copied):
@@ -115,6 +118,173 @@ def _decode_sample(
 
 def _default_fetcher(remote_path: str, local_path: str) -> None:
     shutil.copyfile(remote_path, local_path)
+
+
+def _encode_pil(img) -> bytes:
+    import numpy as _np
+
+    from PIL import Image
+
+    if isinstance(img, _np.ndarray):
+        img = Image.fromarray(img)
+    mode = img.mode.encode("utf-8")
+    w, h = img.size
+    return struct.pack("<III", w, h, len(mode)) + mode + img.tobytes()
+
+
+def _encode_value(encoding: str, value: Any) -> bytes:
+    if encoding in _SCALARS:
+        return np.asarray(value, dtype=_SCALARS[encoding]).tobytes()
+    if encoding == "str":
+        return str(value).encode("utf-8")
+    if encoding == "bytes":
+        return bytes(value)
+    if encoding == "pil":
+        return _encode_pil(value)
+    if encoding in ("jpeg", "png"):
+        from tpuframe.data.streaming import _enc_image
+
+        return _enc_image(encoding.upper())(value)
+    raise ValueError(f"unsupported MDS column encoding {encoding!r}")
+
+
+class MDSWriter:
+    """Write an MDS directory mosaicml-streaming loaders can read.
+
+    The write-side counterpart of :class:`MDSDataset` — same on-disk
+    layout (module docstring), so shards produced here round-trip through
+    the reader AND through stock ``streaming.StreamingDataset``.  API
+    shape mirrors the reference's ``MDSWriter(out, columns, compression)``
+    context-manager loop (`03a_…mds.py:198-206`).
+
+    Args:
+      out_dir: output directory (created; index.json written on close).
+      columns: name -> encoding (pil/jpeg/png/int*/uint*/float*/str/bytes).
+      compression: ``"zstd"``/``"zstd:<level>"`` or None.
+      size_limit: raw bytes per shard before rolling to the next one.
+    """
+
+    def __init__(
+        self,
+        out_dir: str,
+        columns: Mapping[str, str],
+        compression: str | None = "zstd",
+        size_limit: int = 1 << 26,
+    ):
+        for enc in columns.values():
+            if enc not in _SCALARS and enc not in (
+                "str", "bytes", "pil", "jpeg", "png",
+            ):
+                raise ValueError(f"unsupported MDS column encoding {enc!r}")
+        if compression is not None:
+            algo, _, level = compression.partition(":")
+            if algo != "zstd":
+                raise ValueError(f"unsupported MDS compression {compression!r}")
+            self._zstd_level = int(level) if level else 3
+        self.out_dir = out_dir
+        self.columns = dict(columns)
+        self.compression = compression
+        self.size_limit = size_limit
+        os.makedirs(out_dir, exist_ok=True)
+        self._names = list(self.columns)
+        self._encodings = [self.columns[n] for n in self._names]
+        self._sizes = [
+            int(np.dtype(_SCALARS[e]).itemsize) if e in _SCALARS else None
+            for e in self._encodings
+        ]
+        self._samples: list[bytes] = []
+        self._bytes = 0
+        self._entries: list[dict] = []
+        self._closed = False
+
+    def write(self, sample: Mapping[str, Any]) -> None:
+        if self._closed:
+            raise RuntimeError("writer is closed")
+        if set(sample) != set(self._names):
+            raise ValueError(
+                f"sample keys {set(sample)} != columns {set(self._names)}"
+            )
+        head = b""
+        body = b""
+        for name, enc, size in zip(self._names, self._encodings, self._sizes):
+            datum = _encode_value(enc, sample[name])
+            if size is None:
+                head += struct.pack("<I", len(datum))
+            elif len(datum) != size:
+                raise ValueError(
+                    f"column {name!r} ({enc}): {len(datum)} bytes != {size}"
+                )
+            body += datum
+        packed = head + body
+        self._samples.append(packed)
+        self._bytes += len(packed)
+        if self._bytes >= self.size_limit:
+            self._flush_shard()
+
+    def _flush_shard(self) -> None:
+        if not self._samples:
+            return
+        n = len(self._samples)
+        header = 4 + 4 * (n + 1)
+        ends = header + np.cumsum([len(s) for s in self._samples])
+        if int(ends[-1]) >= 1 << 32:
+            # the format's offsets are uint32; assigning larger values
+            # would silently wrap and corrupt the shard
+            raise ValueError(
+                f"MDS shard would be {int(ends[-1])} bytes; the format "
+                "caps shards at 4 GiB — lower size_limit or split samples"
+            )
+        offsets = np.empty(n + 1, dtype="<u4")
+        offsets[0] = header
+        offsets[1:] = ends
+        raw = struct.pack("<I", n) + offsets.tobytes() + b"".join(self._samples)
+        si = len(self._entries)
+        basename = f"shard.{si:05d}.mds"
+        entry = {
+            "column_encodings": list(self._encodings),
+            "column_names": list(self._names),
+            "column_sizes": list(self._sizes),
+            "compression": None,
+            "format": "mds",
+            "hashes": [],
+            "raw_data": {"basename": basename, "bytes": len(raw), "hashes": {}},
+            "samples": n,
+            "size_limit": self.size_limit,
+            "version": 2,
+            "zip_data": None,
+        }
+        if self.compression is None:
+            with open(os.path.join(self.out_dir, basename), "wb") as f:
+                f.write(raw)
+        else:
+            from tpuframe.data.streaming import _zstd_compress
+
+            comp = _zstd_compress(raw, self._zstd_level)
+            zip_name = basename + ".zstd"
+            with open(os.path.join(self.out_dir, zip_name), "wb") as f:
+                f.write(comp)
+            entry["compression"] = f"zstd:{self._zstd_level}"
+            entry["zip_data"] = {
+                "basename": zip_name, "bytes": len(comp), "hashes": {},
+            }
+        self._entries.append(entry)
+        self._samples, self._bytes = [], 0
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._flush_shard()
+        with open(os.path.join(self.out_dir, INDEX_NAME), "w") as f:
+            json.dump(
+                {"shards": self._entries, "version": 2}, f, sort_keys=True
+            )
+        self._closed = True
+
+    def __enter__(self) -> "MDSWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class _Shard:
