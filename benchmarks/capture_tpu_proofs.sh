@@ -19,4 +19,26 @@ run() { # name, timeout_s, cmd...
 run bench_live          600  python bench.py
 run check_kernels_tpu   900  python benchmarks/check_kernels_tpu.py
 run check_offload_tpu   600  python benchmarks/check_offload_tpu.py
+
+# real-data convergence on the chip (text log, not JSON): the digits
+# recipe through the full Trainer — the PERF.md curve, chip edition
+echo "=== convergence_digits ==="
+timeout 900 python examples/08_real_data_convergence.py \
+  --dataset digits --epochs 25 --min-accuracy 0.97 \
+  --workdir /tmp/tpuframe_digits_tpu \
+  > benchmarks/results/convergence_digits_tpu.txt 2>&1
+echo "rc=$?"; tail -3 benchmarks/results/convergence_digits_tpu.txt
+
+# MFU headroom sweep (VERDICT r03 #8); plus one latency-hiding re-run
+echo "=== tpu_experiments ==="
+timeout 1800 python benchmarks/bench_tpu_experiments.py \
+  --configs bn_bf16,bn_bf16_b256,bn_bf16_b512,uint8_in,uint8_in_b256 \
+  > benchmarks/results/tpu_experiments_r04.jsonl 2>/dev/null
+echo "rc=$?"; cat benchmarks/results/tpu_experiments_r04.jsonl
+echo "=== tpu_experiments (latency-hiding scheduler) ==="
+XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" \
+timeout 900 python benchmarks/bench_tpu_experiments.py \
+  --configs bn_bf16,bn_bf16_b256 \
+  > benchmarks/results/tpu_experiments_r04_lhs.jsonl 2>/dev/null
+echo "rc=$?"; cat benchmarks/results/tpu_experiments_r04_lhs.jsonl
 echo "done; inspect benchmarks/results/"
