@@ -8,37 +8,28 @@ set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 
-run() { # name, timeout_s, cmd...
-  local name=$1 tmo=$2; shift 2
-  echo "=== $name ==="
-  timeout "$tmo" "$@" > "benchmarks/results/$name.json" 2> "benchmarks/results/$name.err"
+run() { # outfile, timeout_s, cmd...  (stderr lands beside it as .err)
+  local out=$1 tmo=$2; shift 2
+  echo "=== $out ==="
+  timeout "$tmo" "$@" > "benchmarks/results/$out" 2> "benchmarks/results/$out.err"
   local rc=$?
-  echo "rc=$rc"; tail -c 400 "benchmarks/results/$name.json"; echo
+  echo "rc=$rc"; tail -c 400 "benchmarks/results/$out"; echo
 }
 
-run bench_live          600  python bench.py
-run check_kernels_tpu   900  python benchmarks/check_kernels_tpu.py
-run check_offload_tpu   600  python benchmarks/check_offload_tpu.py
+run bench_live.json          600  python bench.py
+run check_kernels_tpu.json   900  python benchmarks/check_kernels_tpu.py
+run check_offload_tpu.json   600  python benchmarks/check_offload_tpu.py
 
-# real-data convergence on the chip (text log, not JSON): the digits
-# recipe through the full Trainer — the PERF.md curve, chip edition
-echo "=== convergence_digits ==="
-timeout 900 python examples/08_real_data_convergence.py \
+# real-data convergence on the chip: the digits recipe through the full
+# Trainer — the PERF.md curve, chip edition (text log, not JSON)
+run convergence_digits_tpu.txt 900 python examples/08_real_data_convergence.py \
   --dataset digits --epochs 25 --min-accuracy 0.97 \
-  --workdir /tmp/tpuframe_digits_tpu \
-  > benchmarks/results/convergence_digits_tpu.txt 2>&1
-echo "rc=$?"; tail -3 benchmarks/results/convergence_digits_tpu.txt
+  --workdir /tmp/tpuframe_digits_tpu
 
 # MFU headroom sweep (VERDICT r03 #8); plus one latency-hiding re-run
-echo "=== tpu_experiments ==="
-timeout 1800 python benchmarks/bench_tpu_experiments.py \
-  --configs bn_bf16,bn_bf16_b256,bn_bf16_b512,uint8_in,uint8_in_b256 \
-  > benchmarks/results/tpu_experiments_r04.jsonl 2>/dev/null
-echo "rc=$?"; cat benchmarks/results/tpu_experiments_r04.jsonl
-echo "=== tpu_experiments (latency-hiding scheduler) ==="
+run tpu_experiments_r04.jsonl 1800 python benchmarks/bench_tpu_experiments.py \
+  --configs bn_bf16,bn_bf16_b256,bn_bf16_b512,uint8_in,uint8_in_b256
 XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" \
-timeout 900 python benchmarks/bench_tpu_experiments.py \
-  --configs bn_bf16,bn_bf16_b256 \
-  > benchmarks/results/tpu_experiments_r04_lhs.jsonl 2>/dev/null
-echo "rc=$?"; cat benchmarks/results/tpu_experiments_r04_lhs.jsonl
+run tpu_experiments_r04_lhs.jsonl 900 python benchmarks/bench_tpu_experiments.py \
+  --configs bn_bf16,bn_bf16_b256
 echo "done; inspect benchmarks/results/"

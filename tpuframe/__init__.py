@@ -13,6 +13,7 @@ stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
 - ``tpuframe.track``    — MLflow-compatible experiment tracking
 - ``tpuframe.ckpt``     — sharded checkpoint save/restore (orbax-backed)
 - ``tpuframe.ops``      — Pallas TPU kernels for hot ops
+- ``tpuframe.serve``    — portable StableHLO inference artifacts (jax.export)
 """
 
 __version__ = "0.1.0"
@@ -27,6 +28,7 @@ _SUBMODULES = (
     "track",
     "ckpt",
     "ops",
+    "serve",
 )
 
 

@@ -1,0 +1,14 @@
+"""Deployment: portable serialized inference artifacts.
+
+The reference's serving story is an in-notebook demo (single-image
+predict after training, `02_cifar_torch_distributor_resnet.py:370-387`);
+tpuframe keeps that (``train.make_predict_fn``) and adds the deployable
+half: :func:`export_model` freezes (model, variables, preprocessing) into
+a version-stable StableHLO artifact via ``jax.export`` that any JAX
+runtime — CPU serving box or TPU — loads and calls without the model
+code, flax, or the checkpoint being present.
+"""
+
+from tpuframe.serve.export import ExportedModel, export_model, load_model
+
+__all__ = ["ExportedModel", "export_model", "load_model"]

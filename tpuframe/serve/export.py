@@ -1,0 +1,144 @@
+"""StableHLO model export/load (the torchscript/ONNX-export analogue).
+
+``jax.export`` serializes the jitted inference function — model code,
+weights (as constants), and any fused preprocessing — into one portable
+StableHLO blob with versioning guarantees.  The batch dimension is
+symbolic by default, so one artifact serves any batch size.
+
+Why this shape: a TPU-trained model usually ships to a serving runtime
+that has neither the training repo nor flax installed.  A checkpoint
+(`tpuframe.ckpt`) needs the model class to rebuild; the exported artifact
+needs only jax.  (For torch serving, `models/interop.export_torch_resnet`
+is the other exit.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+_MAGIC = "tpuframe-export"
+_VERSION = 1
+
+
+class ExportedModel:
+    """A loaded artifact: ``__call__`` runs inference on numpy/jax arrays."""
+
+    def __init__(self, exported: jax_export.Exported, meta: dict):
+        self._exported = exported
+        self.meta = meta
+
+    def __call__(self, x: Any) -> jax.Array:
+        return self._exported.call(x)
+
+    @property
+    def input_shape(self) -> tuple:
+        return tuple(self.meta["input_shape"])
+
+
+def export_model(
+    model: Any,
+    variables: Any,
+    sample_input: np.ndarray | jax.Array,
+    path: str | os.PathLike,
+    *,
+    preprocess: Callable | None = None,
+    batch_polymorphic: bool = True,
+    apply_kwargs: dict | None = None,
+    platforms: Sequence[str] | None = None,
+) -> str:
+    """Serialize eval-mode ``model.apply(variables, preprocess(x))`` to ``path``.
+
+    Args:
+      model: flax module (``apply(variables, x, **apply_kwargs)``).
+      variables: the trained variables pytree (baked into the artifact).
+      sample_input: one example batch — fixes dtype and trailing shape;
+        its leading dim becomes symbolic when ``batch_polymorphic``.
+      preprocess: optional fn fused in FRONT of the model (e.g. the
+        uint8 ``ops.normalize_images`` transform), so the artifact takes
+        raw bytes and owns its own normalization constants.
+      batch_polymorphic: one artifact for any batch size (default).
+      apply_kwargs: extra kwargs for ``model.apply``.  ``train=False`` is
+        added automatically when the module's ``__call__`` accepts a
+        ``train`` parameter (modules without one export as-is).
+      platforms: lowering platforms, e.g. ``("cpu", "tpu")``; default is
+        the current backend only.
+
+    Returns the written path.  The artifact is self-contained: load it
+    with :func:`load_model` anywhere jax runs.
+    """
+    kwargs = dict(apply_kwargs or {})
+    if "train" not in kwargs:
+        import inspect
+
+        try:
+            params = inspect.signature(type(model).__call__).parameters
+            takes_train = "train" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+            )
+        except (TypeError, ValueError):  # exotic callables: assume flax norm
+            takes_train = True
+        if takes_train:
+            kwargs["train"] = False
+
+    def infer(x):
+        if preprocess is not None:
+            x = preprocess(x)
+        return model.apply(variables, x, **kwargs)
+
+    sample = np.asarray(sample_input)
+    if batch_polymorphic:
+        dims = ", ".join(["b"] + [str(d) for d in sample.shape[1:]])
+        shape = jax_export.symbolic_shape(dims)
+    else:
+        shape = sample.shape
+    spec = jax.ShapeDtypeStruct(shape, sample.dtype)
+    exported = jax_export.export(
+        jax.jit(infer),
+        platforms=tuple(platforms) if platforms else None,
+    )(spec)
+    blob = exported.serialize()
+
+    meta = {
+        "magic": _MAGIC,
+        "version": _VERSION,
+        "input_shape": list(sample.shape),
+        "input_dtype": str(sample.dtype),
+        "batch_polymorphic": batch_polymorphic,
+        "model": type(model).__name__,
+        "platforms": list(exported.platforms),
+        "param_bytes": int(
+            sum(
+                np.asarray(jax.device_get(leaf)).nbytes
+                for leaf in jax.tree.leaves(variables)
+            )
+        ),
+    }
+    path = os.fspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    header = json.dumps(meta).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(len(header).to_bytes(8, "little"))
+        f.write(header)
+        f.write(blob)
+    return path
+
+
+def load_model(path: str | os.PathLike) -> ExportedModel:
+    """Load an :func:`export_model` artifact; no model code needed."""
+    with open(os.fspath(path), "rb") as f:
+        header_len = int.from_bytes(f.read(8), "little")
+        meta = json.loads(f.read(header_len).decode("utf-8"))
+        if meta.get("magic") != _MAGIC:
+            raise ValueError(f"{path} is not a tpuframe export artifact")
+        if meta.get("version") != _VERSION:
+            raise ValueError(
+                f"unsupported artifact version {meta.get('version')}"
+            )
+        blob = f.read()
+    return ExportedModel(jax_export.deserialize(blob), meta)
