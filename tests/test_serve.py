@@ -98,6 +98,93 @@ class TestExportRoundTrip:
         with pytest.raises(ValueError):
             load_model(bad)
 
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            b"PK\x03\x04" + b"\x00" * 64,  # zip magic: huge header_len
+            b"\xff" * 128,  # header_len beyond file size
+            b"\x08\x00\x00\x00\x00\x00\x00\x00" + b"\xfe\xed" * 32,  # non-utf8
+            b"",  # empty file
+        ],
+    )
+    def test_arbitrary_binaries_raise_valueerror(self, tmp_path, payload):
+        bad = tmp_path / "garbage.bin"
+        bad.write_bytes(payload)
+        with pytest.raises(ValueError):
+            load_model(bad)
+
+
+class TestTrainerExport:
+    def test_trained_model_exports_with_normalize_baked_in(self, tmp_path):
+        """Trainer.export: the serving artifact owns the trainer's own
+        normalize= constants, so it consumes the same raw batches
+        training did and reproduces Trainer.predict."""
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.train import Trainer
+
+        ds = SyntheticImageDataset(n=32, image_size=28, channels=1,
+                                   num_classes=4)
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=DataLoader(ds, batch_size=16, shuffle=True,
+                                        process_index=0, process_count=1),
+            max_duration="1ep",
+            num_classes=4,
+            log_interval=0,
+            normalize=((0.5,), (0.25,)),
+        )
+        trainer.fit()
+        path = trainer.export(tmp_path / "trained.shlo")
+        served = load_model(path)
+        # raw batches in the dataset's own dtype (uint8 pixels) — the
+        # artifact's input spec comes from the trainer's init sample
+        raw = np.random.RandomState(0).randint(
+            0, 255, (5, 28, 28, 1)
+        ).astype(served.meta["input_dtype"])
+        np.testing.assert_allclose(
+            np.asarray(served(raw)), trainer.predict(raw),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+class TestShardedTrainerExport:
+    def test_mesh_sharded_params_export_as_single_device_artifact(
+        self, tmp_path
+    ):
+        """A multi-chip trainer's params are sharded jax Arrays; the
+        artifact must NOT remember the training mesh (it serves on one
+        device)."""
+        from tpuframe.core import MeshSpec
+        from tpuframe.core import runtime as rt
+        from tpuframe.data import DataLoader, SyntheticImageDataset
+        from tpuframe.parallel import ParallelPlan
+        from tpuframe.train import Trainer
+
+        rt.reset_runtime()
+        try:
+            rt.initialize(MeshSpec(data=-1))  # all 8 simulated devices
+            plan = ParallelPlan(mesh=rt.current_runtime().mesh)
+            ds = SyntheticImageDataset(n=32, image_size=28, channels=1,
+                                       num_classes=4)
+            trainer = Trainer(
+                MnistNet(num_classes=4),
+                train_dataloader=DataLoader(ds, batch_size=16, shuffle=True,
+                                            process_index=0, process_count=1),
+                max_duration="1ep",
+                num_classes=4,
+                log_interval=0,
+                plan=plan,
+            )
+            trainer.fit()
+            served = load_model(trainer.export(tmp_path / "sharded.shlo"))
+            assert served._exported.nr_devices == 1
+            out = served(
+                np.zeros((3, 28, 28, 1), served.meta["input_dtype"])
+            )
+            assert out.shape == (3, 4)
+        finally:
+            rt.reset_runtime()
+
 
 class TestTorchCheckpointToArtifact:
     def test_imported_torchvision_weights_export_and_serve(self, tmp_path):

@@ -129,12 +129,30 @@ def export_model(
     return path
 
 
+_MAX_HEADER = 1 << 20  # far above any real meta; rejects garbage lengths
+
+
 def load_model(path: str | os.PathLike) -> ExportedModel:
-    """Load an :func:`export_model` artifact; no model code needed."""
-    with open(os.fspath(path), "rb") as f:
+    """Load an :func:`export_model` artifact; no model code needed.
+
+    Any non-artifact file raises ``ValueError`` — the first 8 bytes of
+    arbitrary binaries decode to arbitrary "header lengths", so the
+    length is bounds-checked and header parse failures are wrapped
+    rather than surfacing as MemoryError/UnicodeDecodeError.
+    """
+    path = os.fspath(path)
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
         header_len = int.from_bytes(f.read(8), "little")
-        meta = json.loads(f.read(header_len).decode("utf-8"))
-        if meta.get("magic") != _MAGIC:
+        if not 2 <= header_len <= min(_MAX_HEADER, size):
+            raise ValueError(f"{path} is not a tpuframe export artifact")
+        try:
+            meta = json.loads(f.read(header_len).decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(
+                f"{path} is not a tpuframe export artifact"
+            ) from e
+        if not isinstance(meta, dict) or meta.get("magic") != _MAGIC:
             raise ValueError(f"{path} is not a tpuframe export artifact")
         if meta.get("version") != _VERSION:
             raise ValueError(

@@ -216,13 +216,21 @@ class Trainer:
         # MixUp emit 0-255 floats), mean/std in [0, 1] units.  Pass an
         # explicit third element to override the 1/255 scale.
         self.normalize = normalize
+        # The ONE place the normalize tuple is interpreted — training,
+        # eval, and the serving-artifact export all read these, so the
+        # preprocessing convention cannot skew between them.
+        if normalize is not None:
+            _mean, _std, *_rest = normalize
+            self._norm_args = (_mean, _std, _rest[0] if _rest else 1.0 / 255.0)
+        else:
+            self._norm_args = None
 
         def image_transform(img, mesh):
             from tpuframe.ops import normalize_images
 
-            mean, std, *rest = normalize
+            mean, std, scale = self._norm_args
             return normalize_images(
-                img, mean, std, scale=rest[0] if rest else 1.0 / 255.0,
+                img, mean, std, scale=scale,
                 out_dtype=self.policy.compute_dtype, mesh=mesh,
                 batch_axes=tuple(self.plan.data_axes),
             )
@@ -689,6 +697,64 @@ class Trainer:
         single-image demo path adds the batch dim itself)."""
         state = self.init_state()
         return np.asarray(self._predict(state, np.asarray(images)))
+
+    def export(
+        self,
+        path: str,
+        sample_input: np.ndarray | None = None,
+        batch_polymorphic: bool = True,
+        platforms: tuple[str, ...] | None = None,
+    ) -> str:
+        """Freeze the trained model into a portable serving artifact.
+
+        Bundles the current params/batch_stats AND the trainer's
+        ``normalize=`` preprocessing into one StableHLO blob via
+        :func:`tpuframe.serve.export_model` — callers of the artifact
+        send the same raw batches training consumed.  Portability over
+        performance, deliberately: params are gathered to host numpy
+        (the artifact must not remember the training mesh's device
+        count) and the normalize runs the plain-jnp reference path (the
+        compiled Pallas kernel would pin the artifact to TPU).
+        ``sample_input`` defaults to the trainer's own init sample;
+        ``platforms=("cpu", "tpu")`` lowers for both targets.
+        """
+        from tpuframe.serve import export_model
+
+        state = self.init_state()
+        variables = {"params": state.params}
+        if jax.tree.leaves(state.batch_stats):
+            variables["batch_stats"] = state.batch_stats
+        # host-gathered constants: a multi-chip trainer's params are
+        # sharded Arrays, and closing over those would bake the training
+        # mesh's device count into the artifact
+        variables = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)), variables
+        )
+        if sample_input is None:
+            if self.sample_input is None:
+                raise ValueError("pass sample_input= (none known to the trainer)")
+            sample_input = self.sample_input
+        preprocess = None
+        if self._norm_args is not None:
+            from tpuframe.ops.normalize import normalize_images_reference
+
+            mean, std, scale = self._norm_args
+            out_dtype = self.policy.compute_dtype
+
+            def preprocess(x):
+                return normalize_images_reference(
+                    x, mean, std, scale, out_dtype
+                )
+
+        return export_model(
+            self.model,
+            variables,
+            sample_input,
+            path,
+            preprocess=preprocess,
+            batch_polymorphic=batch_polymorphic,
+            platforms=platforms,
+        )
 
 
 def _planned_total_steps(duration, dataloader) -> int | None:
