@@ -291,7 +291,13 @@ class TestOptimizerFromConfig:
         want, _ = ref.update(g, ref.init(params), params)
         np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(want["w"]))
         with pytest.raises(ValueError, match="unknown optimizer"):
-            optimizer_from_config({"optimizer": {"type": "Adafactor"}})
+            optimizer_from_config({"optimizer": {"type": "Shampoo"}})
+        # adafactor graduated from "unknown" to supported (LLM-scale
+        # factored second moments; state is O(rows+cols) per matrix)
+        af = optimizer_from_config(
+            {"optimizer": {"type": "Adafactor", "params": {"lr": 1e-3}}}
+        )
+        assert af.init({"w": jnp.ones((256, 256))})
         with pytest.raises(ValueError, match="no scheduler"):
             optimizer_from_config(
                 {"optimizer": {"type": "AdamW", "params": {"lr": "auto"}}}

@@ -25,6 +25,7 @@ from tpuframe.train.schedules import (
 )
 from tpuframe.train.optim import optimizer_from_config
 from tpuframe.train.schedules import from_config as schedule_from_config
+from tpuframe.train.ema import EmaState, ema_params, with_ema
 from tpuframe.train.state import TrainState, create_train_state, param_count
 from tpuframe.train.step import (
     cross_entropy,
@@ -40,6 +41,9 @@ from tpuframe.train.trainer import FitResult, Trainer
 __all__ = [
     "Algorithm",
     "ChannelsLast",
+    "EmaState",
+    "ema_params",
+    "with_ema",
     "CutMix",
     "LabelSmoothing",
     "MixUp",

@@ -1,0 +1,79 @@
+"""Exponential moving average of parameters (Composer/timm's EMA).
+
+TPU-first shape: the EMA is not a separate host-side copy to synchronize
+(the torch pattern) — it lives INSIDE the optimizer state as one more
+param-shaped pytree, updated in the same fused XLA step as the optimizer
+itself.  Because ``ParallelPlan.state_shardings`` shards param-shaped
+state leaves by suffix match, the EMA is automatically ZeRO-sharded over
+the fsdp axis with zero extra plumbing, and checkpoints carry it for
+free (it is just opt_state).
+
+Usage::
+
+    tx = with_ema(optax.adamw(3e-4), decay=0.999)   # outermost wrapper
+    ...
+    eval_params = ema_params(state)                 # the averaged weights
+
+or ``Trainer(ema_decay=0.999)``, which evaluates/predicts/exports with
+the averaged weights automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import optax
+
+__all__ = ["EmaState", "with_ema", "ema_params"]
+
+
+class EmaState(NamedTuple):
+    inner: Any
+    ema: Any
+
+
+def with_ema(
+    tx: optax.GradientTransformation, decay: float = 0.999
+) -> optax.GradientTransformation:
+    """Wrap ``tx`` so its state also tracks ``ema = d*ema + (1-d)*params``.
+
+    Must be the OUTERMOST wrapper (``ema_params`` looks for :class:`EmaState`
+    at the top of the optimizer state).  The average starts at the initial
+    params (no zero-init bias, so no debiasing step is needed), and each
+    ``update`` folds the POST-update params in — the average always lags
+    the live weights by the usual EMA horizon ``1/(1-decay)`` steps.
+    """
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"decay must be in (0, 1), got {decay}")
+
+    def init(params):
+        return EmaState(tx.init(params), params)
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("with_ema requires params= in update()")
+        new_updates, inner = tx.update(updates, state.inner, params)
+        new_params = optax.apply_updates(params, new_updates)
+        ema = jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p, state.ema, new_params
+        )
+        return new_updates, EmaState(inner, ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def ema_params(state_or_opt_state: Any) -> Any:
+    """The averaged params from a TrainState (or its opt_state).
+
+    Raises ``ValueError`` when the optimizer was not wrapped with
+    :func:`with_ema` — silently returning live params would make an
+    "EMA eval" a lie.
+    """
+    opt_state = getattr(state_or_opt_state, "opt_state", state_or_opt_state)
+    if isinstance(opt_state, EmaState):
+        return opt_state.ema
+    raise ValueError(
+        "optimizer state carries no EMA — wrap the optimizer with "
+        "with_ema(tx) (outermost) or pass Trainer(ema_decay=...)"
+    )

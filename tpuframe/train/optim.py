@@ -50,6 +50,20 @@ def _lion(lr, p):
     )
 
 
+def _adafactor(lr, p):
+    # the LLM-scale memory-lean choice: factored second moments mean the
+    # ZeRO-sharded optimizer state is O(rows+cols) per matrix, not O(n).
+    # min_dim_size_to_factor guards small tensors (mirrors optax default).
+    return optax.adafactor(
+        lr,
+        min_dim_size_to_factor=int(p.get("min_dim_size_to_factor", 128)),
+        decay_rate=float(p.get("decay_rate", 0.8)),
+        weight_decay_rate=(
+            float(p["weight_decay"]) if "weight_decay" in p else None
+        ),
+    )
+
+
 #: single source of truth for supported types (error messages derive from it)
 _OPTIMIZERS = {
     "adamw": _adamw,
@@ -58,8 +72,9 @@ _OPTIMIZERS = {
     "lamb": lambda lr, p: optax.lamb(
         lr, weight_decay=float(p.get("weight_decay", 0.0))
     ),
-    # not a DeepSpeed type, but keeps parity with Trainer's optimizer= names
+    # not DeepSpeed types, but keep parity with Trainer's optimizer= names
     "lion": _lion,
+    "adafactor": _adafactor,
 }
 
 

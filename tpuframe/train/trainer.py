@@ -92,6 +92,10 @@ class Trainer:
         compute with f32 master params).
       checkpointer: tpuframe.ckpt.Checkpointer (optional; saved per
         ``checkpoint_interval`` epochs + best tracking).
+      ema_decay: maintain an exponential moving average of the params
+        inside the optimizer state (fused into the train step,
+        ZeRO-sharded, checkpointed for free); evaluate/predict/export
+        then use the averaged weights.  Typical: 0.999.
       checkpoint_interval_batches: additionally save every N global
         batches *inside* an epoch, bundling the consumer-true loader
         position — a crash then auto-resumes with the very next batch
@@ -128,6 +132,7 @@ class Trainer:
         grad_clip: float | None = None,
         grad_compression: str | None = None,
         normalize: tuple | None = None,
+        ema_decay: float | None = None,
     ):
         if precision is None:
             # follow the model: an explicitly-bf16 model keeps bf16 compute
@@ -177,6 +182,14 @@ class Trainer:
                 "grad_clip only applies when the Trainer builds the optimizer "
                 "(tx=None); chain optax.clip_by_global_norm into your tx instead"
             )
+        self.ema_decay = ema_decay
+        if ema_decay is not None:
+            # outermost wrapper: the averaged weights live in opt_state
+            # (ZeRO-sharded + checkpointed for free); evaluate/predict/
+            # export then use them via _serving_state()
+            from tpuframe.train.ema import with_ema
+
+            tx = with_ema(tx, float(ema_decay))
         self.tx = tx
 
         if num_classes is None:
@@ -684,7 +697,7 @@ class Trainer:
                 stacklevel=2,
             )
             self._warned_eval_drop = True
-        state = self.init_state()
+        state = self._serving_state()
         self.eval_dataloader.set_epoch(0)
         acc = None
         for batch in self._device_batches(self.eval_dataloader, train=False):
@@ -692,10 +705,21 @@ class Trainer:
             acc = merge_metrics(acc, metrics)
         return summarize_metrics(acc or {}, prefix="eval_")
 
+    def _serving_state(self) -> TrainState:
+        """The state evaluate/predict/export should read weights from:
+        the live params, or the EMA average when ``ema_decay`` is on
+        (the whole point of maintaining the average)."""
+        state = self.init_state()
+        if self.ema_decay is None:
+            return state
+        from tpuframe.train.ema import ema_params
+
+        return state.replace(params=ema_params(state))
+
     def predict(self, images: np.ndarray) -> np.ndarray:
         """Logits for a (N, H, W, C) image batch (the reference's
         single-image demo path adds the batch dim itself)."""
-        state = self.init_state()
+        state = self._serving_state()
         return np.asarray(self._predict(state, np.asarray(images)))
 
     def export(
@@ -720,13 +744,19 @@ class Trainer:
         """
         from tpuframe.serve import export_model
 
-        state = self.init_state()
+        state = self._serving_state()
         variables = {"params": state.params}
         if jax.tree.leaves(state.batch_stats):
             variables["batch_stats"] = state.batch_stats
         # host-gathered constants: a multi-chip trainer's params are
         # sharded Arrays, and closing over those would bake the training
-        # mesh's device count into the artifact
+        # mesh's device count into the artifact.  Across processes a
+        # plain device_get cannot read non-addressable shards, so gather
+        # collectively first.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            variables = multihost_utils.process_allgather(variables)
         variables = jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), variables
         )
@@ -786,6 +816,7 @@ def _make_optimizer(name: str, lr: float | optax.Schedule) -> optax.GradientTran
         "sgd": lambda lr: optax.sgd(lr, momentum=0.9),
         "lamb": optax.lamb,
         "lion": optax.lion,
+        "adafactor": optax.adafactor,
     }
     try:
         return table[name.lower()](lr)
