@@ -158,3 +158,28 @@ def test_lm_moe_expert_parallel(tmp_path):
         tmp_path=tmp_path,
     )
     assert "attn=full" in out
+
+
+def test_export_serving_roundtrip(tmp_path):
+    """09: train -> export -> serve from nothing but the artifact."""
+    out = run_example(
+        "09_export_serving.py",
+        "--serve-batch", "8", "--ema", "0.9",
+        tmp_path=tmp_path,
+    )
+    assert "finished" in out and "ms/batch" in out
+    assert (tmp_path / "model.shlo").exists()
+
+
+def test_export_serving_from_torch_fixture(tmp_path):
+    """09 --from-torch: a torchvision-format .pt straight to an artifact."""
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "resnet18_tv_w4.pt"
+    )
+    out = run_example(
+        "09_export_serving.py",
+        "--from-torch", fixture, "--serve-batch", "4",
+        tmp_path=tmp_path,
+    )
+    assert "exported torch checkpoint (width=4)" in out
+    assert "finished" in out
