@@ -20,6 +20,10 @@ run bench_live.json          600  python bench.py
 run check_kernels_tpu.json   900  python benchmarks/check_kernels_tpu.py
 run check_offload_tpu.json   600  python benchmarks/check_offload_tpu.py
 
+# end-to-end data-fed bench (VERDICT r04 #4): JPEG shards -> decode ->
+# augment -> prefetch -> train on the chip, with input-stall attribution
+run bench_e2e_tpu.json       900  python benchmarks/bench_e2e.py
+
 # real-data convergence on the chip: the digits recipe through the full
 # Trainer — the PERF.md curve, chip edition (text log, not JSON)
 run convergence_digits_tpu.txt 900 python examples/08_real_data_convergence.py \

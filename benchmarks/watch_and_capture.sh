@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Tunnel watcher: makes the next live TPU window un-missable.
+#
+# The axon tunnel's observed failure mode is a hard wedge — `jax.devices()`
+# hangs forever rather than erroring — so the probe is a `timeout`-bounded
+# subprocess.  The moment the backend answers, run the full proof capture
+# (benchmarks/capture_tpu_proofs.sh) and git-commit benchmarks/results/ so
+# the evidence survives even if the tunnel wedges again mid-session.
+#
+# Usage:  nohup benchmarks/watch_and_capture.sh >/tmp/tpu_watch.log 2>&1 &
+# Start this at round-start, every session (VERDICT r04 next-round #1).
+set -u
+cd "$(dirname "$0")/.."
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"   # s per probe; wedged probes hang, never error
+POLL_INTERVAL="${POLL_INTERVAL:-180}"   # s between probes while the tunnel is down
+MAX_HOURS="${MAX_HOURS:-12}"
+
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+attempt=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  attempt=$((attempt + 1))
+  echo "[watch] probe #$attempt $(date -u +%FT%TZ)"
+  if timeout "$PROBE_TIMEOUT" python - <<'EOF'
+import jax
+devs = jax.devices()
+assert any(d.platform == "tpu" for d in devs), devs
+print("live:", devs)
+EOF
+  then
+    echo "[watch] TPU live at $(date -u +%FT%TZ) — capturing proofs"
+    bash benchmarks/capture_tpu_proofs.sh
+    git add benchmarks/results
+    # pathspec-limited commit: never sweep unrelated staged work into the
+    # automated commit
+    git commit -m "TPU live window: captured on-chip proof artifacts (watch_and_capture)" \
+      -- benchmarks/results \
+      || echo "[watch] nothing new to commit"
+    # Keep watching: a later window can refresh artifacts, and a partial
+    # capture (tunnel re-wedged mid-run) should be retried.
+    if [ -s benchmarks/results/bench_live.json ] \
+       && grep -q '"backend": *"tpu"' benchmarks/results/bench_live.json; then
+      echo "[watch] live bench recorded; exiting"
+      exit 0
+    fi
+  fi
+  sleep "$POLL_INTERVAL"
+done
+echo "[watch] deadline reached without a complete live capture"

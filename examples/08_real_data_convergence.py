@@ -25,6 +25,16 @@ Two datasets:
 
 Run:  python 08_real_data_convergence.py --dataset digits --epochs 25 \
           --min-accuracy 0.97 --workdir /tmp/digits
+
+Elastic mode (accuracy + fault tolerance in ONE measured run — the
+combination the reference never exercises): ``--elastic`` supervises the
+recipe as a child process whose FIRST attempt hard-crashes mid-epoch
+(``os._exit``, no cleanup — a real SIGKILL-grade failure), then restarts
+it; the restart auto-resumes from the Trainer's mid-epoch snapshot and
+must still clear the same accuracy gate:
+
+      python 08_real_data_convergence.py --dataset digits --epochs 25 \
+          --min-accuracy 0.97 --elastic --workdir /tmp/digits_elastic
 """
 
 from __future__ import annotations
@@ -43,6 +53,26 @@ from tpuframe.ckpt import Checkpointer
 from tpuframe.data import ArrayDataset, DataLoader
 from tpuframe.models import MnistNet, ResNet18
 from tpuframe.train import LabelSmoothing, Trainer, warmup_cosine
+from tpuframe.train.callbacks import Callback
+
+
+class CrashAt(Callback):
+    """Simulated hard failure: ``os._exit`` after N global batches — no
+    exception, no checkpoint flush, no atexit; the crash class the elastic
+    restart path must survive (`tpuframe.launch.elastic` semantics, driven
+    cross-process here because a dead process can't retry itself)."""
+
+    def __init__(self, at_batches: int):
+        self.at = int(at_batches)
+
+    def on_step_end(self, trainer: Trainer) -> None:
+        # on_step_end fires every batch (on_batch_end only at log
+        # intervals), so the kill lands genuinely MID-epoch — the restart
+        # must resume from an intra-epoch snapshot, not an epoch boundary
+        if trainer.batches_seen >= self.at:
+            print(f"[crash-sim] hard exit at global batch "
+                  f"{trainer.batches_seen}", flush=True)
+            os._exit(13)
 
 
 def load_digits_arrays(n_train: int = 1500, seed: int = 0):
@@ -104,6 +134,11 @@ def train_digits(args) -> float:
         num_classes=10,
         log_interval=0,
         eval_interval=args.eval_interval,
+        callbacks=(
+            [CrashAt(args.simulate_crash_at_batch)]
+            if args.simulate_crash_at_batch is not None else []
+        ),
+        checkpoint_interval_batches=args.checkpoint_interval_batches,
         checkpointer=Checkpointer(
             os.path.join(args.workdir, "ck"), best_metric="eval_accuracy",
             best_mode="max",
@@ -111,9 +146,21 @@ def train_digits(args) -> float:
         seed=args.seed,
     )
     result = trainer.fit()
+    return report(result, trainer, args.epochs)
+
+
+def report(result, trainer, total_epochs: int) -> float:
+    """Print the accuracy curve (absolute epochs — after an auto-resume the
+    history only covers the resumed stretch) and return final accuracy.
+    A fit() that resumed an already-complete run has no fresh eval in its
+    metrics; fall back to an explicit eval of the restored state."""
+    offset = total_epochs - len(result.history)
     for e, h in enumerate(result.history):
         if "eval_accuracy" in h:
-            print(f"epoch {e + 1}: eval_accuracy={h['eval_accuracy']:.4f}")
+            print(f"epoch {offset + e + 1}: "
+                  f"eval_accuracy={h['eval_accuracy']:.4f}")
+    if "eval_accuracy" not in result.metrics:
+        return float(trainer.evaluate()["eval_accuracy"])
     return float(result.metrics["eval_accuracy"])
 
 
@@ -165,6 +212,11 @@ def train_cifar10(args) -> float:
         num_classes=10,
         log_interval=0,
         eval_interval=args.eval_interval,
+        callbacks=(
+            [CrashAt(args.simulate_crash_at_batch)]
+            if args.simulate_crash_at_batch is not None else []
+        ),
+        checkpoint_interval_batches=args.checkpoint_interval_batches,
         checkpointer=Checkpointer(
             os.path.join(args.workdir, "ck"), best_metric="eval_accuracy",
             best_mode="max",
@@ -172,10 +224,75 @@ def train_cifar10(args) -> float:
         seed=args.seed,
     )
     result = trainer.fit()
-    for e, h in enumerate(result.history):
-        if "eval_accuracy" in h:
-            print(f"epoch {e + 1}: eval_accuracy={h['eval_accuracy']:.4f}")
-    return float(result.metrics["eval_accuracy"])
+    return report(result, trainer, args.epochs)
+
+
+def run_elastic(args, argv: list[str]) -> None:
+    """Supervise the recipe as a restartable child (elastic + accuracy in
+    one run): attempt 1 gets ``--simulate-crash-at-batch`` and dies
+    mid-epoch; each restart reruns WITHOUT the crash flag and auto-resumes
+    from the Trainer's snapshots in ``--workdir``.  Exit code is the final
+    child's (so the ``--min-accuracy`` gate still decides)."""
+    import subprocess
+
+    def strip_flag(av: list[str], flag: str) -> list[str]:
+        out, skip = [], False
+        for a in av:
+            if skip:
+                skip = False
+            elif a == flag:
+                skip = True  # drop the flag and its value
+            elif not a.startswith(flag + "="):
+                out.append(a)
+        return out
+
+    # the supervisor owns these: the crash flag must NOT survive into
+    # restarts (the resumed child would re-crash at the same batch), and
+    # the snapshot interval is re-appended uniformly below
+    child_argv = [a for a in argv if a != "--elastic"]
+    for flag in ("--simulate-crash-at-batch", "--checkpoint-interval-batches"):
+        child_argv = strip_flag(child_argv, flag)
+    base = [sys.executable, os.path.abspath(__file__)] + child_argv
+    crash = (40 if args.simulate_crash_at_batch is None
+             else args.simulate_crash_at_batch)
+    snap = (7 if args.checkpoint_interval_batches is None
+            else args.checkpoint_interval_batches)
+    for attempt in range(args.max_restarts + 1):
+        cmd = list(base)
+        if attempt == 0:
+            cmd += ["--simulate-crash-at-batch", str(crash)]
+        cmd += ["--checkpoint-interval-batches", str(snap)]
+        print(f"[elastic] attempt {attempt + 1}: {' '.join(cmd[2:])}",
+              flush=True)
+        rc = subprocess.call(cmd)
+        if rc == 0:
+            if attempt == 0:
+                print("[elastic] simulated crash never fired — run shorter "
+                      f"than --simulate-crash-at-batch {crash}? Nothing was "
+                      "validated.", file=sys.stderr, flush=True)
+                sys.exit(3)
+            print(f"[elastic] recovered and finished after {attempt} "
+                  f"restart(s)", flush=True)
+            sys.exit(0)
+        if rc == 1:
+            # gate rejection / uncaught python error: a BUG class, not an
+            # infra failure — restarting a finished-but-rejected run would
+            # just re-verify the same checkpoint (elastic.py's _FATAL
+            # classification, cross-process edition)
+            print(f"[elastic] child failed terminally rc={rc}; not "
+                  f"restarting", file=sys.stderr, flush=True)
+            sys.exit(rc)
+        if attempt == 0 and rc != 13:
+            print(f"[elastic] expected simulated crash rc=13, got rc={rc}",
+                  file=sys.stderr, flush=True)
+            sys.exit(rc)
+        if attempt == args.max_restarts:
+            print(f"[elastic] retry budget exhausted (rc={rc})",
+                  file=sys.stderr, flush=True)
+            sys.exit(rc if rc else 1)
+        print(f"[elastic] child failed rc={rc}; restarting with auto-resume "
+              f"from {args.workdir}/ck", flush=True)
+    sys.exit(1)  # unreachable unless max_restarts < 0
 
 
 def main() -> None:
@@ -189,8 +306,20 @@ def main() -> None:
     ap.add_argument("--workdir", default="/tmp/tpuframe_convergence")
     ap.add_argument("--data-npz", default=None,
                     help="cifar10 arrays: x_train/y_train/x_test/y_test")
+    ap.add_argument("--elastic", action="store_true",
+                    help="supervise with a simulated mid-epoch crash + "
+                    "auto-resume restart")
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--simulate-crash-at-batch", type=int, default=None,
+                    help="hard os._exit(13) after N global batches")
+    ap.add_argument("--checkpoint-interval-batches", type=int, default=None,
+                    help="mid-epoch snapshot every N batches")
     args = ap.parse_args()
     os.makedirs(args.workdir, exist_ok=True)
+
+    if args.elastic:
+        run_elastic(args, sys.argv[1:])
+        return
 
     if args.dataset == "digits":
         acc = train_digits(args)

@@ -1,0 +1,38 @@
+"""The end-to-end data-fed benchmark (benchmarks/bench_e2e.py) emits a
+valid record: volume build -> StreamingDataset/MDSDataset -> DataLoader ->
+DevicePrefetcher -> train step, with stall attribution.  This is the
+driver-shaped contract (one JSON line) for the SURVEY §7 "input pipeline
+feeding HBM" measurement; the chip numbers land via
+benchmarks/capture_tpu_proofs.sh."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fmt", ["tfs", "mds"])
+def test_bench_e2e_emits_record(fmt, tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_e2e.py"),
+         "--format", fmt, "--images", "48", "--batch", "8", "--steps", "2",
+         "--size", "32", "--workers", "1",
+         "--volume-dir", str(tmp_path / "vol")],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "resnet50_e2e_data_fed_images_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["format"] == fmt
+    assert rec["synthetic_images_per_sec_per_chip"] > 0
+    assert 0.0 <= rec["input_stall_pct"] <= 100.0
+    assert 0.0 <= rec["host_input_wait_frac"] <= 1.0

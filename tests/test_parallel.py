@@ -241,9 +241,11 @@ class TestHostOffload:
 
         assert not supports_host_offload()  # CPU backend in tests
         params, opt = self._shapes()
-        plan = ParallelPlan(
-            mesh=mesh8, zero_stage=3, min_shard_elems=1, offload_optimizer=True
-        )
+        with pytest.warns(UserWarning, match="downgrading to plain ZeRO-3"):
+            plan = ParallelPlan(
+                mesh=mesh8, zero_stage=3, min_shard_elems=1,
+                offload_optimizer=True,
+            )
         shardings = plan.state_shardings(opt, params)
         for s in __import__("jax").tree.leaves(
             shardings, is_leaf=lambda x: hasattr(x, "memory_kind")
@@ -279,7 +281,8 @@ class TestHostOffload:
     def test_zero_3_offload_preset_and_from_dict(self, mesh8):
         from tpuframe.parallel import ZeroConfig, zero_3_offload
 
-        plan = zero_3_offload(mesh8)
+        with pytest.warns(UserWarning, match="downgrading to plain ZeRO-3"):
+            plan = zero_3_offload(mesh8)  # CPU test backend: must warn
         assert plan.zero_stage == 3 and plan.offload_optimizer
         cfg = ZeroConfig.from_dict(
             {"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}}}
@@ -303,7 +306,10 @@ class TestHostOffload:
 
         from tpuframe.parallel import ZeroConfig
 
-        plan = ZeroConfig(stage=3, offload_optimizer=True, min_shard_elems=1).plan(mesh8)
+        with pytest.warns(UserWarning, match="downgrading to plain ZeRO-3"):
+            plan = ZeroConfig(
+                stage=3, offload_optimizer=True, min_shard_elems=1
+            ).plan(mesh8)
         state = create_train_state(
             MnistNet(num_classes=10),
             jax.random.PRNGKey(0),

@@ -29,14 +29,34 @@ This implements the public MDS on-disk layout (mosaicml-streaming's
 Decode-on-access only — no shared memory, no background workers: shard
 files are memory-mapped-size reads and the DataLoader's process sharding
 already keeps each host on its own subset.
+
+.. note:: **Validation gap** (this sandbox has no egress, so
+   ``mosaicml-streaming`` is not installed): stock mosaicml-streaming has
+   never read bytes written by :class:`MDSWriter`.  The format tests in
+   ``tests/test_mds.py`` cover fixture shards from an independent
+   from-spec generator plus randomized writer→reader round trips and
+   corruption rejection, but on any machine with egress run this once::
+
+       pip install mosaicml-streaming
+       python - <<'EOF'
+       import streaming, numpy as np
+       from tpuframe.data import MDSWriter
+       with MDSWriter("/tmp/v", {"image": "pil", "label": "int"}) as w:
+           for i in range(8):
+               w.write({"image": np.full((4, 4, 3), i, np.uint8), "label": i})
+       ds = streaming.StreamingDataset(local="/tmp/v", shuffle=False)
+       assert [s["label"] for s in ds] == list(range(8))
+       EOF
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import struct
+import threading
 from typing import Any, Callable, Mapping
 
 import numpy as np
@@ -216,10 +236,12 @@ class MDSWriter:
                 )
             body += datum
         packed = head + body
+        # roll-first (mosaicml-streaming semantics): a shard never exceeds
+        # size_limit unless a single sample alone does
+        if self._samples and self._bytes + len(packed) > self.size_limit:
+            self._flush_shard()
         self._samples.append(packed)
         self._bytes += len(packed)
-        if self._bytes >= self.size_limit:
-            self._flush_shard()
 
     def _flush_shard(self) -> None:
         if not self._samples:
@@ -246,8 +268,12 @@ class MDSWriter:
             "column_sizes": list(self._sizes),
             "compression": None,
             "format": "mds",
-            "hashes": [],
-            "raw_data": {"basename": basename, "bytes": len(raw), "hashes": {}},
+            "hashes": ["sha256"],
+            "raw_data": {
+                "basename": basename,
+                "bytes": len(raw),
+                "hashes": {"sha256": hashlib.sha256(raw).hexdigest()},
+            },
             "samples": n,
             "size_limit": self.size_limit,
             "version": 2,
@@ -265,7 +291,9 @@ class MDSWriter:
                 f.write(comp)
             entry["compression"] = f"zstd:{self._zstd_level}"
             entry["zip_data"] = {
-                "basename": zip_name, "bytes": len(comp), "hashes": {},
+                "basename": zip_name,
+                "bytes": len(comp),
+                "hashes": {"sha256": hashlib.sha256(comp).hexdigest()},
             }
         self._entries.append(entry)
         self._samples, self._bytes = [], 0
@@ -288,32 +316,24 @@ class MDSWriter:
 
 
 class _Shard:
-    """One MDS shard: lazy-loaded raw bytes + the offsets table."""
+    """One MDS shard: lazily-cached (raw bytes, offsets table)."""
 
     def __init__(self, entry: dict, reader: "MDSDataset"):
         self.entry = entry
         self.reader = reader
         self.samples = int(entry["samples"])
-        self._raw: bytes | None = None
-        self._offsets: np.ndarray | None = None
+        # cache slot, mutated only under the reader's lock; readers take a
+        # local reference first, so eviction can never null it mid-slice
+        self._data: tuple[bytes, np.ndarray] | None = None
 
-    def _load(self) -> None:
-        if self._raw is not None:
-            return
+    def read(self) -> tuple[bytes, np.ndarray]:
+        """Fetch + decompress + verify from storage (no caching here).
+        Verification (incl. the header sample count) lives in
+        ``_shard_bytes`` so a bad cached download is evicted+retried."""
         raw = self.reader._shard_bytes(self.entry)
-        n = struct.unpack_from("<I", raw, 0)[0]
-        if n != self.samples:
-            raise IOError(
-                f"MDS shard {self.entry['raw_data']['basename']}: header says "
-                f"{n} samples, index.json says {self.samples}"
-            )
-        self._offsets = np.frombuffer(raw, dtype="<u4", count=n + 1, offset=4)
-        self._raw = raw
-
-    def sample_bytes(self, i: int) -> bytes:
-        self._load()
-        begin, end = int(self._offsets[i]), int(self._offsets[i + 1])
-        return self._raw[begin:end]
+        offsets = np.frombuffer(raw, dtype="<u4", count=self.samples + 1,
+                                offset=4)
+        return raw, offsets
 
 
 class MDSDataset:
@@ -348,7 +368,12 @@ class MDSDataset:
         rng_seed: int = 0,
     ):
         self.remote = remote
-        self.local_cache = local_cache
+        # normalized so the evict-on-corruption guard's prefix compare
+        # can't be defeated by a trailing slash
+        self.local_cache = (
+            os.path.normpath(local_cache) if local_cache is not None else None
+        )
+        local_cache = self.local_cache
         self.transform = transform
         self.image_key = image_key
         self.label_key = label_key
@@ -361,9 +386,22 @@ class MDSDataset:
             os.makedirs(local_cache, exist_ok=True)
             local_index = os.path.join(local_cache, INDEX_NAME)
             if not os.path.exists(local_index):
-                tmp = f"{local_index}.{os.getpid()}.tmp"
-                fetcher(index_path, tmp)
-                os.replace(tmp, local_index)
+                # same per-attempt tmp + cleanup discipline as shard
+                # fetches: concurrent constructors over one cache must not
+                # collide, and a failed fetch must not orphan a .tmp
+                tmp = (f"{local_index}.{os.getpid()}"
+                       f".{threading.get_ident()}.tmp")
+                try:
+                    fetcher(index_path, tmp)
+                except BaseException:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    if not os.path.exists(local_index):  # racing winner?
+                        raise
+                else:
+                    os.replace(tmp, local_index)
             index_path = local_index
         with open(index_path) as f:
             self.index = json.load(f)
@@ -375,8 +413,10 @@ class MDSDataset:
             if e.get("format", "mds") != "mds":
                 raise ValueError(f"unsupported shard format {e.get('format')!r}")
         self._starts = np.cumsum([0] + [s.samples for s in self.shards])
+        self._lock = threading.Lock()
         self._lru: list[int] = []
         self._lru_cap = max(1, keep_decoded_shards)
+        self._fetch_errors: dict[str, str] = {}
 
     # -- io -----------------------------------------------------------------
     def _local_path(self, basename: str) -> str | None:
@@ -387,46 +427,120 @@ class MDSDataset:
         local = os.path.join(self.local_cache, basename)
         if os.path.exists(local):
             return local
-        if not os.path.exists(remote_path):
-            return None
-        tmp = f"{local}.{os.getpid()}.tmp"
-        self.fetcher(remote_path, tmp)
-        os.replace(tmp, local)
+        # always *attempt* the fetch: ``remote`` may be an object-store URI
+        # a custom fetcher understands but os.path.exists never will; a
+        # failed fetch means "absent here" and the caller falls back to the
+        # sibling file — but the error is RECORDED so a final
+        # FileNotFoundError can surface the real cause (auth failure vs
+        # genuinely missing).  The tmp name is unique per ATTEMPT (pid AND
+        # thread id): the load path is deliberately unlocked, so two thread
+        # workers missing the same shard must not collide on one tmp file.
+        tmp = f"{local}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            self.fetcher(remote_path, tmp)
+        except Exception as e:
+            with self._lock:
+                self._fetch_errors[basename] = repr(e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            # a racing worker may have installed the file while our
+            # duplicate fetch failed (e.g. object-store 429): the shard
+            # being present trumps our fetch error
+            return local if os.path.exists(local) else None
+        with self._lock:
+            self._fetch_errors.pop(basename, None)
+        os.replace(tmp, local)  # atomic: a racing winner's file is complete
         return local
 
-    def _shard_bytes(self, entry: dict) -> bytes:
-        """Raw (decompressed) shard bytes; prefers an existing raw file,
-        else decompresses ``zip_data`` (``compression: "zstd[:level]"``)."""
-        raw_info = entry["raw_data"]
-        path = self._local_path(raw_info["basename"])
-        if path is not None:
-            with open(path, "rb") as f:
-                data = f.read()
-        else:
-            zip_info = entry.get("zip_data")
-            if not zip_info:
-                raise FileNotFoundError(
-                    f"shard {raw_info['basename']} missing and no zip_data"
+    @staticmethod
+    def _check_hash(info: dict, data: bytes) -> None:
+        """Verify the entry's recorded sha256 when present (the format's
+        optional ``hashes`` field); zstd frames carry no content checksum
+        by default, so this is the only mid-stream corruption detector."""
+        want = (info.get("hashes") or {}).get("sha256")
+        if want is not None:
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                raise IOError(
+                    f"shard {info['basename']}: sha256 {got} != "
+                    f"index.json's {want}"
                 )
-            zpath = self._local_path(zip_info["basename"])
-            if zpath is None:
-                raise FileNotFoundError(
-                    f"neither {raw_info['basename']} nor "
-                    f"{zip_info['basename']} present under {self.remote}"
-                )
-            algo = (entry.get("compression") or "").split(":")[0]
-            if algo != "zstd":
-                raise ValueError(f"unsupported MDS compression {algo!r}")
-            from tpuframe.data.streaming import _zstd_decompress
 
-            with open(zpath, "rb") as f:
-                data = _zstd_decompress(f.read(), int(raw_info["bytes"]))
-        expected = int(raw_info["bytes"])
-        if len(data) != expected:
-            raise IOError(
-                f"shard {raw_info['basename']}: {len(data)} bytes != "
-                f"index.json's {expected}"
+    def _shard_bytes(self, entry: dict, _retry: bool = True) -> bytes:
+        """Raw (decompressed) shard bytes.  A compressed volume normally
+        ships ONLY ``zip_data`` (MDSWriter's layout), so that file is
+        probed first — probing raw first would pay a guaranteed failed
+        remote fetch on every shard (re)load; an uncompressed or
+        keep-raw volume falls through to ``raw_data``.  A verification
+        failure (length/sha256) evicts the cached copy — a corrupted
+        download must not poison the cache forever — and retries the
+        fetch once before surfacing the error."""
+        raw_info = entry["raw_data"]
+        zip_info = entry.get("zip_data")
+        candidates = ([("zip", zip_info)] if zip_info else []) + [
+            ("raw", raw_info)
+        ]
+        kind = path = None
+        for kind, info in candidates:
+            path = self._local_path(info["basename"])
+            if path is not None:
+                break
+        if path is None:
+            names = " nor ".join(i["basename"] for _, i in candidates)
+            with self._lock:
+                snapshot = dict(self._fetch_errors)
+            errors = {
+                b: e for b, e in snapshot.items()
+                if any(b == i["basename"] for _, i in candidates)
+            }
+            raise FileNotFoundError(
+                f"neither {names} present under {self.remote}"
+                + (f"; fetch errors: {errors}" if errors else "")
             )
+        with open(path, "rb") as f:
+            data = f.read()
+        algo = (entry.get("compression") or "").split(":")[0]
+        if kind == "zip" and algo != "zstd":
+            raise ValueError(f"unsupported MDS compression {algo!r}")
+        try:
+            if kind == "zip":
+                from tpuframe.data.streaming import _zstd_decompress
+
+                self._check_hash(zip_info, data)
+                data = _zstd_decompress(data, int(raw_info["bytes"]))
+            expected = int(raw_info["bytes"])
+            if len(data) != expected:
+                raise IOError(
+                    f"shard {raw_info['basename']}: {len(data)} bytes != "
+                    f"index.json's {expected}"
+                )
+            if kind == "raw":
+                # when kind == "zip" the download was already verified via
+                # zip_data's hash and decompression is deterministic —
+                # re-hashing the decompressed bytes would double the
+                # per-reload hashing for nothing
+                self._check_hash(raw_info, data)
+            n = struct.unpack_from("<I", data, 0)[0]
+            if n != int(entry["samples"]):
+                raise IOError(
+                    f"MDS shard {raw_info['basename']}: header says {n} "
+                    f"samples, index.json says {entry['samples']}"
+                )
+        except Exception:
+            # IOError (length/hash/count) OR a decompressor error on a
+            # hash-less volume: either way this cached copy is bad
+            if self.local_cache is not None and path.startswith(
+                self.local_cache + os.sep
+            ):
+                try:
+                    os.remove(path)  # don't let a bad download stick
+                except OSError:
+                    pass
+                if _retry:
+                    return self._shard_bytes(entry, _retry=False)
+            raise
         return data
 
     # -- dataset protocol ---------------------------------------------------
@@ -443,21 +557,33 @@ class MDSDataset:
         si = int(np.searchsorted(self._starts, idx, side="right") - 1)
         shard = self.shards[si]
         entry = shard.entry
-        rec = _decode_sample(
-            shard.sample_bytes(idx - int(self._starts[si])),
+        # DataLoader's thread workers call this concurrently.  The lock
+        # guards ONLY the cache slot + LRU bookkeeping; the expensive load
+        # (fetch/decompress/hash) and decode run unlocked.  Two threads may
+        # race-load the same shard once (harmless, last write wins); the
+        # local ``cached`` reference keeps the bytes alive even if another
+        # thread evicts the slot mid-slice.
+        with self._lock:
+            cached = shard._data
+        if cached is None:
+            cached = shard.read()
+        with self._lock:
+            shard._data = cached
+            # bound memory: keep only the most recently touched shards' bytes
+            if si in self._lru:
+                self._lru.remove(si)
+            self._lru.append(si)
+            while len(self._lru) > self._lru_cap:
+                self.shards[self._lru.pop(0)]._data = None
+        raw, offsets = cached
+        i = idx - int(self._starts[si])
+        data = raw[int(offsets[i]) : int(offsets[i + 1])]
+        return _decode_sample(
+            data,
             entry["column_names"],
             entry["column_encodings"],
             entry["column_sizes"],
         )
-        # bound memory: keep only the most recently touched shards' bytes
-        if si in self._lru:
-            self._lru.remove(si)
-        self._lru.append(si)
-        while len(self._lru) > self._lru_cap:
-            old = self._lru.pop(0)
-            self.shards[old]._raw = None
-            self.shards[old]._offsets = None
-        return rec
 
     def __getitem__(self, idx: int):
         rec = self.sample(int(idx))
@@ -473,11 +599,13 @@ class MDSDataset:
         state = self.__dict__.copy()
         state["shards"] = None
         state["_lru"] = []
+        state["_lock"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self.shards = [_Shard(e, self) for e in self.index["shards"]]
+        self._lock = threading.Lock()
 
 
 def mds_to_tfs(

@@ -302,8 +302,18 @@ class StreamingDataset:
             return os.path.join(self.remote, shard["file"])
         local = os.path.join(self.local_cache, shard["file"])
         if not os.path.exists(local):
-            tmp = f"{local}.{os.getpid()}.tmp"
-            self.fetcher(os.path.join(self.remote, shard["file"]), tmp)
+            # tmp unique per pid AND thread: the load path is unlocked, so
+            # two thread workers missing the same shard must not collide
+            # on one tmp file (one would os.replace it mid-write)
+            tmp = f"{local}.{os.getpid()}.{threading.get_ident()}.tmp"
+            try:
+                self.fetcher(os.path.join(self.remote, shard["file"]), tmp)
+            except BaseException:
+                try:
+                    os.remove(tmp)  # no orphaned partial downloads
+                except OSError:
+                    pass
+                raise
             os.replace(tmp, local)  # atomic: concurrent workers see full files
         return local
 

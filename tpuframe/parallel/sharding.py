@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import warnings
 from typing import Any, Sequence
 
 import jax
@@ -112,13 +113,31 @@ class ParallelPlan:
     data_axes: Sequence[str] = (DATA_AXIS, FSDP_AXIS)
     #: DeepSpeed stage-3 CPU offload (`deepspeed_config.py:87-105`):
     #: optimizer-state leaves live in pinned host memory and stream to HBM
-    #: inside the update.  Applied only when the backend has a
-    #: ``pinned_host`` memory space (real TPUs); CPU simulation skips it.
+    #: inside the update.  EXPERIMENTAL: applied only when the backend has
+    #: a usable ``pinned_host`` memory space (real TPUs — CPU simulation
+    #: downgrades with a warning), and the pinned-host path has not yet
+    #: been executed on real TPU hardware in this repo —
+    #: ``benchmarks/check_offload_tpu.py`` is the acceptance harness and
+    #: its committed JSON in ``benchmarks/results/`` is the proof of
+    #: support on a given backend.
     offload_optimizer: bool = False
 
     def __post_init__(self):
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_stage must be 0..3, got {self.zero_stage}")
+        if self.offload_optimizer and not host_memory_available(self.mesh):
+            # loud, not silent: a user who asked for DeepSpeed-style CPU
+            # offload must know their optimizer state is staying in HBM
+            warnings.warn(
+                "offload_optimizer=True requested but backend "
+                f"{jax.default_backend()!r} has no usable pinned_host memory "
+                f"space; downgrading to plain ZeRO-{self.zero_stage} "
+                "(optimizer state stays in device HBM). Host offload is "
+                "EXPERIMENTAL: run benchmarks/check_offload_tpu.py on the "
+                "target backend to validate it before relying on the "
+                "memory savings.",
+                stacklevel=3,
+            )
 
     def _offload_active(self) -> bool:
         return self.offload_optimizer and host_memory_available(self.mesh)
