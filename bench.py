@@ -72,6 +72,29 @@ def enable_compile_cache(default_dir: str = "/tmp/tpuframe_xla_cache") -> None:
         pass
 
 
+def make_uint8_normalize_transform(plan, on_accel: bool):
+    """Batch transform for raw-uint8 input: fused on-device normalize
+    emitting the compute dtype directly, sharded like the trainer's own
+    normalize path (mesh/batch_axes keep GSPMD from gathering the full
+    batch onto every chip).  Shared by bench_e2e.py and
+    bench_tpu_experiments.py so the A/B and the e2e bench can never
+    diverge on normalize semantics."""
+    import jax.numpy as jnp
+
+    from tpuframe.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+    from tpuframe.ops import normalize_images
+
+    def batch_transform(b: dict) -> dict:
+        b["image"] = normalize_images(
+            b["image"], IMAGENET_MEAN, IMAGENET_STD,
+            out_dtype=jnp.bfloat16 if on_accel else jnp.float32,
+            mesh=plan.mesh, batch_axes=tuple(plan.data_axes),
+        )
+        return b
+
+    return batch_transform
+
+
 def _peak_flops(device_kind: str) -> float | None:
     kind = device_kind.lower()
     for key, peak in _PEAK_BF16:

@@ -89,28 +89,18 @@ def run_config(name: str, cfg: dict, steps: int) -> dict:
             "label": rng.integers(0, 1000, (cfg["batch"],)).astype(np.int32),
         }
     )
-    batch_transform = None
-    if uint8_input:
-        from tpuframe.data.transforms import IMAGENET_MEAN, IMAGENET_STD
-        from tpuframe.ops import normalize_images
-
-        def batch_transform(b: dict) -> dict:
-            # raw bytes ride host->HBM; the fused Pallas normalize emits
-            # bf16 directly, so no f32 image tensor ever exists on chip.
-            # mesh/batch_axes shard the kernel like the trainer's own
-            # normalize path (trainer.py) — without them GSPMD would
-            # gather the full batch onto every chip and skew the A/B.
-            b["image"] = normalize_images(
-                b["image"], IMAGENET_MEAN, IMAGENET_STD,
-                out_dtype=jnp.bfloat16,
-                mesh=plan.mesh, batch_axes=tuple(plan.data_axes),
-            )
-            return b
-
     # bench.py owns the measurement methodology (timing windows, cost
-    # analysis, device-kind peak table); a silent CPU fallback must be
-    # visible in the record, not attributed to the chip (BENCH_r02 lesson)
+    # analysis, device-kind peak table) AND the shared uint8 fused
+    # normalize; a silent CPU fallback must be visible in the record, not
+    # attributed to the chip (BENCH_r02 lesson)
     import bench as headline_bench
+
+    batch_transform = (
+        headline_bench.make_uint8_normalize_transform(
+            plan, on_accel=jax.default_backend() != "cpu"
+        )
+        if uint8_input else None
+    )
 
     compiled = (
         make_train_step(policy, batch_transform=batch_transform)

@@ -21,8 +21,15 @@ run check_kernels_tpu.json   900  python benchmarks/check_kernels_tpu.py
 run check_offload_tpu.json   600  python benchmarks/check_offload_tpu.py
 
 # end-to-end data-fed bench (VERDICT r04 #4): JPEG shards -> decode ->
-# augment -> prefetch -> train on the chip, with input-stall attribution
+# augment -> prefetch -> train on the chip, with input-stall attribution;
+# the uint8 variant ships raw bytes host->HBM + fused on-device normalize
+# (the r03 A/B's input-side lever, now end-to-end)
 run bench_e2e_tpu.json       900  python benchmarks/bench_e2e.py
+run bench_e2e_tpu_uint8.json 900  python benchmarks/bench_e2e.py --uint8-input
+
+# LM tokens/s + MFU incl. the seq-8192 blockwise flash path — turns the
+# "98k tok/s / 4.2x long-context" PERF.md prose into committed JSON
+run bench_lm_tpu.jsonl       900  python benchmarks/bench_lm.py
 
 # real-data convergence on the chip: the digits recipe through the full
 # Trainer — the PERF.md curve, chip edition (text log, not JSON)

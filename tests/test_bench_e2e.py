@@ -16,13 +16,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("fmt", ["tfs", "mds"])
-def test_bench_e2e_emits_record(fmt, tmp_path):
+@pytest.mark.parametrize("fmt,extra", [
+    ("tfs", []),
+    ("mds", []),
+    ("tfs", ["--uint8-input"]),  # raw-bytes H2D + fused on-device normalize
+])
+def test_bench_e2e_emits_record(fmt, extra, tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "bench_e2e.py"),
          "--format", fmt, "--images", "48", "--batch", "8", "--steps", "2",
          "--size", "32", "--workers", "1",
-         "--volume-dir", str(tmp_path / "vol")],
+         "--volume-dir", str(tmp_path / "vol")] + extra,
         capture_output=True, text=True, timeout=900,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "PALLAS_AXON_POOL_IPS": ""},

@@ -438,13 +438,15 @@ class MDSDataset:
         tmp = f"{local}.{os.getpid()}.{threading.get_ident()}.tmp"
         try:
             self.fetcher(remote_path, tmp)
-        except Exception as e:
-            with self._lock:
-                self._fetch_errors[basename] = repr(e)
+        except BaseException as e:
             try:
                 os.remove(tmp)
             except OSError:
                 pass
+            if not isinstance(e, Exception):
+                raise  # KeyboardInterrupt/SystemExit: clean up, propagate
+            with self._lock:
+                self._fetch_errors[basename] = repr(e)
             # a racing worker may have installed the file while our
             # duplicate fetch failed (e.g. object-store 429): the shard
             # being present trumps our fetch error
@@ -479,7 +481,11 @@ class MDSDataset:
         fetch once before surfacing the error."""
         raw_info = entry["raw_data"]
         zip_info = entry.get("zip_data")
-        candidates = ([("zip", zip_info)] if zip_info else []) + [
+        algo = (entry.get("compression") or "").split(":")[0]
+        # a zip file under an unsupported codec is never a candidate — a
+        # keep-raw volume (raw sibling present) must still be readable
+        zip_usable = bool(zip_info) and algo == "zstd"
+        candidates = ([("zip", zip_info)] if zip_usable else []) + [
             ("raw", raw_info)
         ]
         kind = path = None
@@ -495,15 +501,17 @@ class MDSDataset:
                 b: e for b, e in snapshot.items()
                 if any(b == i["basename"] for _, i in candidates)
             }
+            detail = f"; fetch errors: {errors}" if errors else ""
+            if zip_info and not zip_usable:
+                detail += (
+                    f"; zip_data exists but its compression {algo!r} is "
+                    "unsupported (only zstd)"
+                )
             raise FileNotFoundError(
-                f"neither {names} present under {self.remote}"
-                + (f"; fetch errors: {errors}" if errors else "")
+                f"neither {names} present under {self.remote}{detail}"
             )
         with open(path, "rb") as f:
             data = f.read()
-        algo = (entry.get("compression") or "").split(":")[0]
-        if kind == "zip" and algo != "zstd":
-            raise ValueError(f"unsupported MDS compression {algo!r}")
         try:
             if kind == "zip":
                 from tpuframe.data.streaming import _zstd_decompress

@@ -308,11 +308,16 @@ class StreamingDataset:
             tmp = f"{local}.{os.getpid()}.{threading.get_ident()}.tmp"
             try:
                 self.fetcher(os.path.join(self.remote, shard["file"]), tmp)
-            except BaseException:
+            except BaseException as e:
                 try:
                     os.remove(tmp)  # no orphaned partial downloads
                 except OSError:
                     pass
+                # a racing worker may have installed the shard while our
+                # duplicate fetch failed (e.g. object-store 429) — but
+                # never swallow KeyboardInterrupt/SystemExit
+                if isinstance(e, Exception) and os.path.exists(local):
+                    return local
                 raise
             os.replace(tmp, local)  # atomic: concurrent workers see full files
         return local
