@@ -9,12 +9,41 @@ cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 
 run() { # outfile, timeout_s, cmd...  (stderr lands beside it as .err)
+  # Capture to a staging file and promote only on success, so a re-run
+  # during a flaky window (the watcher retries until bench_live is
+  # on-chip) can never overwrite a good artifact with a failed one; an
+  # existing on-chip record is also never replaced by a CPU-fallback one.
   local out=$1 tmo=$2; shift 2
+  local dst="benchmarks/results/$out"
   echo "=== $out ==="
-  timeout "$tmo" "$@" > "benchmarks/results/$out" 2> "benchmarks/results/$out.err"
+  timeout "$tmo" "$@" > "$dst.new" 2> "$dst.err"
   local rc=$?
-  echo "rc=$rc"; tail -c 400 "benchmarks/results/$out"; echo
+  if [ $rc -eq 0 ] && [ -s "$dst.new" ]; then
+    if [ -f "$dst" ] && grep -q '"backend": *"tpu"' "$dst" \
+       && ! grep -q '"backend": *"tpu"' "$dst.new"; then
+      echo "rc=0 but keeping existing ON-CHIP $out (new capture fell back)"
+      rm -f "$dst.new"
+    else
+      mv "$dst.new" "$dst"
+    fi
+  else
+    echo "rung failed rc=$rc; keeping previous $out (if any)"
+    rm -f "$dst.new"
+  fi
+  tail -c 400 "$dst" 2>/dev/null; echo
 }
+
+# provenance: what backend/device this capture pass actually saw
+python - <<'EOF' > benchmarks/results/capture_session.json 2>/dev/null || true
+import datetime, json
+import jax
+print(json.dumps({
+    "captured_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+    "backend": jax.default_backend(),
+    "devices": [str(d) for d in jax.devices()],
+    "device_kind": jax.devices()[0].device_kind,
+}))
+EOF
 
 run bench_live.json          600  python bench.py
 run check_kernels_tpu.json   900  python benchmarks/check_kernels_tpu.py

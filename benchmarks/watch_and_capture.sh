@@ -17,6 +17,11 @@ MAX_HOURS="${MAX_HOURS:-12}"
 
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 attempt=0
+mkdir -p benchmarks/results
+journal="benchmarks/results/tunnel_probes.jsonl"
+note() { # verdict — committed evidence that polling actually happened
+  echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"probe\": $attempt, \"verdict\": \"$1\"}" >> "$journal"
+}
 while [ "$(date +%s)" -lt "$deadline" ]; do
   attempt=$((attempt + 1))
   echo "[watch] probe #$attempt $(date -u +%FT%TZ)"
@@ -27,6 +32,7 @@ assert any(d.platform == "tpu" for d in devs), devs
 print("live:", devs)
 EOF
   then
+    note live
     echo "[watch] TPU live at $(date -u +%FT%TZ) — capturing proofs"
     bash benchmarks/capture_tpu_proofs.sh
     git add benchmarks/results
@@ -42,6 +48,8 @@ EOF
       echo "[watch] live bench recorded; exiting"
       exit 0
     fi
+  else
+    note wedged
   fi
   sleep "$POLL_INTERVAL"
 done
