@@ -54,3 +54,9 @@ EOF
   sleep "$POLL_INTERVAL"
 done
 echo "[watch] deadline reached without a complete live capture"
+# an all-wedged session still commits its probe journal — the polling
+# evidence matters most precisely when the tunnel never answered
+git add benchmarks/results
+git commit -m "tunnel watcher: probe journal (no live window this session)" \
+  -- benchmarks/results \
+  || echo "[watch] nothing to commit at deadline"
