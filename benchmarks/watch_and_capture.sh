@@ -22,6 +22,11 @@ journal="benchmarks/results/tunnel_probes.jsonl"
 note() { # verdict — committed evidence that polling actually happened
   echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"probe\": $attempt, \"verdict\": \"$1\"}" >> "$journal"
 }
+commit_results() { # $1: message — pathspec-limited, never sweeps staged work
+  git add benchmarks/results
+  git commit -m "$1" -- benchmarks/results \
+    || echo "[watch] nothing to commit"
+}
 while [ "$(date +%s)" -lt "$deadline" ]; do
   attempt=$((attempt + 1))
   echo "[watch] probe #$attempt $(date -u +%FT%TZ)"
@@ -35,12 +40,7 @@ EOF
     note live
     echo "[watch] TPU live at $(date -u +%FT%TZ) — capturing proofs"
     bash benchmarks/capture_tpu_proofs.sh
-    git add benchmarks/results
-    # pathspec-limited commit: never sweep unrelated staged work into the
-    # automated commit
-    git commit -m "TPU live window: captured on-chip proof artifacts (watch_and_capture)" \
-      -- benchmarks/results \
-      || echo "[watch] nothing new to commit"
+    commit_results "TPU live window: captured on-chip proof artifacts (watch_and_capture)"
     # Keep watching: a later window can refresh artifacts, and a partial
     # capture (tunnel re-wedged mid-run) should be retried.
     if [ -s benchmarks/results/bench_live.json ] \
@@ -56,7 +56,4 @@ done
 echo "[watch] deadline reached without a complete live capture"
 # an all-wedged session still commits its probe journal — the polling
 # evidence matters most precisely when the tunnel never answered
-git add benchmarks/results
-git commit -m "tunnel watcher: probe journal (no live window this session)" \
-  -- benchmarks/results \
-  || echo "[watch] nothing to commit at deadline"
+commit_results "tunnel watcher: probe journal (no live window this session)"
