@@ -74,8 +74,13 @@ run() { # outfile, timeout_s, cmd...  (stderr lands beside it as .err)
     elif ! grep -q '"backend"' "$dst.new" && verify_onchip; then
       fresh_onchip=1
     fi
+    # defense-in-depth: the content guard (old record SAYS tpu, new one
+    # doesn't) protects on-chip evidence even when its .onchip sidecar is
+    # missing (selective git add, fresh clone, pre-stamp artifacts)
     if { [ "$ONCHIP" -eq 1 ] || [ ! -f "$dst.onchip" ]; } \
-       && ! { [ -f "$dst.onchip" ] && [ "$fresh_onchip" -eq 0 ]; }; then
+       && ! { [ -f "$dst.onchip" ] && [ "$fresh_onchip" -eq 0 ]; } \
+       && ! { [ -f "$dst" ] && grep -q '"backend": *"tpu"' "$dst" \
+              && [ "$fresh_onchip" -eq 0 ]; }; then
       mv "$dst.new" "$dst"
       mv "$dst.err.new" "$dst.err" 2>/dev/null || true
       if [ "$fresh_onchip" -eq 1 ]; then touch "$dst.onchip"; fi

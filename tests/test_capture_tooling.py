@@ -119,3 +119,16 @@ class TestCaptureRun:
 def test_scripts_parse(script):
     subprocess.run(["bash", "-n", os.path.join(REPO, "benchmarks", script)],
                    check=True, timeout=30)
+
+
+class TestCaptureRunDefenseInDepth:
+    def test_unstamped_tpu_content_survives_cpu_pass(self, tmp_path):
+        """On-chip evidence whose .onchip sidecar is missing (selective
+        git add, fresh clone, pre-stamp artifacts) is still protected by
+        the content guard: old record SAYS tpu, new one doesn't."""
+        res = tmp_path / "benchmarks" / "results"
+        res.mkdir(parents=True)
+        (res / "bench_live.json").write_text('{"backend": "tpu", "v": 1}')
+        run_rung(tmp_path, 0, "bench_live.json",
+                 'echo "{\\"backend\\": \\"cpu\\", \\"v\\": 2}"')
+        assert '"v": 1' in read(tmp_path, "bench_live.json")

@@ -1,0 +1,45 @@
+"""`python -m tpuframe` environment doctor: the CLI face of the
+reference's setup bootstrap report (`setup/00_setup.py:105-123` prints
+worker/GPU topology); ours must emit one parseable JSON report and—
+critically—never hang on a wedged backend."""
+
+import json
+import os
+import subprocess
+import sys
+
+from tpuframe import doctor
+
+
+def test_report_shape_on_cpu(monkeypatch):
+    # the probe subprocess inherits env: pin CPU so this never touches a
+    # (possibly wedged) remote backend, same as the CLI test below
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    rec = doctor.report(probe_timeout_s=60)
+    assert rec["tpuframe"]
+    assert rec["devices"]["backend"] == "cpu"
+    assert rec["devices"]["device_count"] >= 1
+    assert "mesh_hint" in rec and "DP" in rec["mesh_hint"]
+    assert isinstance(rec["native_extensions"]["built"], list)
+    assert rec["optional_deps"]["msgpack"]  # hard dep, must resolve
+
+
+def test_probe_never_hangs_on_wedged_backend(monkeypatch):
+    """The documented axon failure mode: jax.devices() hangs forever.
+    The probe must time out and return a diagnosis, not hang."""
+    monkeypatch.setattr(doctor, "_PROBE_SRC", "import time; time.sleep(60)")
+    rec = doctor.probe_devices(timeout_s=0.5)
+    assert "wedged" in rec["error"]
+
+
+def test_cli_emits_parseable_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuframe", "--probe-timeout", "60"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    rec = json.loads(proc.stdout)
+    assert rec["devices"]["backend"] == "cpu"

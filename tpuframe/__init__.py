@@ -16,7 +16,7 @@ stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
 - ``tpuframe.serve``    — portable StableHLO inference artifacts (jax.export)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # single source: pyproject reads this via setuptools dynamic
 
 _SUBMODULES = (
     "core",

@@ -1,0 +1,6 @@
+"""``python -m tpuframe`` -> the environment doctor (tpuframe.doctor)."""
+
+from tpuframe.doctor import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

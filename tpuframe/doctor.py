@@ -1,0 +1,126 @@
+"""Environment doctor: one-command report of what this install can do.
+
+``python -m tpuframe`` is the CLI face of the reference's setup cell —
+`/root/reference/setup/00_setup.py:105-123` prints worker counts, GPU
+topology and debug-env state at bootstrap; this prints the tpuframe
+equivalents (backend, devices, mesh hint, native extensions, codecs,
+compile cache) as one JSON report a user can paste into a bug report.
+
+The device probe runs in a TIMEOUT-BOUNDED subprocess: on a wedged
+remote backend ``jax.devices()`` hangs forever rather than erroring
+(the axon-tunnel failure mode), and a diagnostics tool that hangs on
+exactly the environment it should diagnose is useless.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+_PROBE_SRC = (
+    "import json, jax; d = jax.devices(); "
+    "print(json.dumps({'backend': jax.default_backend(), "
+    "'device_count': jax.device_count(), "
+    "'local_device_count': jax.local_device_count(), "
+    "'process_index': jax.process_index(), "
+    "'process_count': jax.process_count(), "
+    "'device_kinds': sorted({dev.device_kind for dev in d}), "
+    "'jax_version': jax.__version__}))"
+)
+
+
+def _module_version(name: str) -> str | None:
+    try:
+        mod = importlib.import_module(name)
+        return getattr(mod, "__version__", "installed")
+    except Exception:
+        return None
+
+
+def probe_devices(timeout_s: float = 30.0) -> dict:
+    """Backend/topology via a bounded child (never hangs the doctor)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "error": f"device probe hung > {timeout_s:.0f}s — backend "
+            "wedged (the axon-tunnel failure mode); CPU fallback: "
+            "JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="
+        }
+    if proc.returncode != 0:
+        detail = (proc.stderr or proc.stdout).strip()[-500:]
+        # never an empty/falsy error: a silently-killed child (OOM,
+        # segfault) must still read as a failed probe
+        return {"error": f"probe exited rc={proc.returncode}: "
+                         f"{detail or '(no output)'}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
+
+
+def report(probe_timeout_s: float = 30.0) -> dict:
+    """Collect the full environment report (pure data; printing is main's)."""
+    import tpuframe
+
+    from tpuframe.core import native
+
+    devices = probe_devices(probe_timeout_s)
+    built = []
+    build_dir = os.path.join(os.path.dirname(native.__file__), os.pardir,
+                             "_native", "build")
+    if os.path.isdir(build_dir):
+        built = sorted(f for f in os.listdir(build_dir) if f.endswith(".so"))
+    mesh_hint = None
+    n = devices.get("device_count")
+    if isinstance(n, int) and n > 0:
+        mesh_hint = (f"MeshSpec(data=-1) -> {n}-way DP; "
+                     f"MeshSpec(data={max(1, n // 8)}, fsdp=8) for ZeRO" if n >= 8
+                     else f"MeshSpec(data=-1) -> {n}-way DP")
+    return {
+        "tpuframe": tpuframe.__version__,
+        "python": sys.version.split()[0],
+        "devices": devices,
+        "mesh_hint": mesh_hint,
+        "native_extensions": {
+            "toolchain_available": native.native_available(),
+            "built": built,
+        },
+        "optional_deps": {
+            name: _module_version(name)
+            for name in ("zstandard", "PIL", "torch", "orbax.checkpoint",
+                         "cloudpickle", "msgpack")
+        },
+        "compile_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        "env": {
+            k: os.environ[k]
+            for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
+                      "TPUFRAME_DEBUG")
+            if k in os.environ
+        },
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuframe",
+        description="tpuframe environment doctor (one JSON report)",
+    )
+    ap.add_argument("--probe-timeout", type=float, default=30.0,
+                    help="seconds before declaring the backend wedged")
+    args = ap.parse_args(argv)
+    rec = report(args.probe_timeout)
+    print(json.dumps(rec, indent=2))
+    return 1 if "error" in rec["devices"] else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    raise SystemExit(main())
