@@ -131,6 +131,15 @@ def main() -> None:
         finally:
             lp.close()
 
+        # decode-only A/B: PIL (holds the GIL) vs the C++ libjpeg batch
+        # decoder (GIL-free) — inline and across all cores' threads.  On a
+        # multi-core host the native thread column is the one that decides
+        # whether one host can feed the chip (SURVEY §7).
+        decode_only = _decode_only_ab(
+            [open(p, "rb").read() for p in ds.paths],
+            min(args.seconds, 4.0), cores,
+        )
+
     best_mode, (best, best_cores) = max(results.items(), key=lambda kv: kv[1][0])
     per_core = best / best_cores
     print(
@@ -142,6 +151,7 @@ def main() -> None:
                 f"batch {batch})",
                 "per_core": round(per_core, 1),
                 "modes": {k: round(v, 1) for k, (v, _) in results.items()},
+                "decode_only": decode_only,
                 "chip_ingest_img_s": CHIP_INGEST_IMG_S,
                 # cores one host needs to keep ONE v5e chip fed at the
                 # measured train rate
@@ -149,6 +159,47 @@ def main() -> None:
             }
         )
     )
+
+
+def _decode_only_ab(blobs: list, seconds: float, cores: int) -> dict:
+    import io
+    from concurrent.futures import ThreadPoolExecutor
+
+    from PIL import Image
+
+    def pil_dec(b: bytes):
+        # mirrors _dec_image's PIL path exactly (no convert("RGB") — the
+        # working set is already RGB; an extra full-frame copy would
+        # inflate the native column's advantage)
+        return np.asarray(Image.open(io.BytesIO(b)))
+
+    fns = {"pil": pil_dec}
+    try:
+        from tpuframe.core.native import JpegDecoder, jpeg_native_available
+
+        if jpeg_native_available():
+            fns["native"] = JpegDecoder(n_threads=1).decode
+    except Exception:
+        pass
+
+    def rate(fn, pool=None) -> float:
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            if pool is None:
+                for b in blobs:
+                    fn(b)
+            else:
+                list(pool.map(fn, blobs))
+            n += len(blobs)
+        return n / (time.perf_counter() - t0)
+
+    out = {}
+    for name, fn in fns.items():
+        out[f"{name}_1t"] = round(rate(fn), 1)
+        if cores > 1:
+            with ThreadPoolExecutor(cores) as pool:
+                out[f"{name}_{cores}t"] = round(rate(fn, pool), 1)
+    return out
 
 
 if __name__ == "__main__":

@@ -6,6 +6,8 @@ import multiprocessing as mp
 import os
 import socket
 
+import numpy as np
+
 import pytest
 
 from tpuframe.core.native import ControlPlane, ZstdCodec, native_available
@@ -225,3 +227,103 @@ class TestHeartbeat:
                 assert mon.ms_since(1) == -1  # impostor never registers
             finally:
                 beacon.close()
+
+
+class TestJpegDecoder:
+    """C++ libjpeg batch decoder (jpegdec.cpp): pixel parity with PIL
+    (same libjpeg-turbo lineage), shape conventions, corruption
+    rejection, and the streaming fast-path seam."""
+
+    @staticmethod
+    def _jpeg(img: np.ndarray, mode: str = "RGB", quality: int = 90) -> bytes:
+        import io
+
+        from PIL import Image
+
+        pil = Image.fromarray(img if mode == "RGB" else img[:, :, 0], mode)
+        buf = io.BytesIO()
+        pil.save(buf, "JPEG", quality=quality)
+        return buf.getvalue()
+
+    @staticmethod
+    def _pil_decode(blob: bytes) -> np.ndarray:
+        import io
+
+        from PIL import Image
+
+        return np.asarray(Image.open(io.BytesIO(blob)))
+
+    def _smooth(self, rng, h, w):
+        base = rng.integers(0, 256, (8, 8, 3), dtype=np.uint8)
+        return np.kron(base, np.ones((h // 8 + 1, w // 8 + 1, 1),
+                                     np.uint8))[:h, :w]
+
+    def test_batch_matches_pil_rgb_and_grayscale(self):
+        from tpuframe.core.native import JpegDecoder, jpeg_native_available
+
+        if not jpeg_native_available():
+            pytest.skip("no g++/libjpeg toolchain")
+        rng = np.random.default_rng(0)
+        blobs = []
+        for i in range(10):
+            h, w = int(rng.integers(16, 260)), int(rng.integers(16, 260))
+            blobs.append(self._jpeg(self._smooth(rng, h, w),
+                                    mode="L" if i % 3 == 0 else "RGB",
+                                    quality=int(rng.integers(60, 96))))
+        outs = JpegDecoder(n_threads=4).decode_batch(blobs)
+        for i, (out, blob) in enumerate(zip(outs, blobs)):
+            ref = self._pil_decode(blob)
+            assert out.shape == ref.shape, i  # HW for gray, HWC for RGB
+            # bit-exact on libjpeg-turbo both sides (this image); allow
+            # +/-1 LSB where -ljpeg resolves to IJG v9 instead (different
+            # chroma upsampling rounding, both decoders correct)
+            diff = np.abs(out.astype(np.int16) - ref.astype(np.int16))
+            assert int(diff.max()) <= 1, (i, int(diff.max()))
+
+    def test_corrupt_and_truncated_rejected_with_index(self):
+        from tpuframe.core.native import JpegDecoder, jpeg_native_available
+
+        if not jpeg_native_available():
+            pytest.skip("no g++/libjpeg toolchain")
+        rng = np.random.default_rng(1)
+        good = self._jpeg(self._smooth(rng, 64, 64))
+        dec = JpegDecoder()
+        with pytest.raises(ValueError, match="item 1"):
+            dec.decode_batch([good, b"\xff\xd8garbage"])
+        with pytest.raises(ValueError):
+            dec.decode(good[: len(good) // 2])
+
+    def test_streaming_dec_image_uses_native_fast_path(self, monkeypatch):
+        from tpuframe.core.native import jpeg_native_available
+        from tpuframe.data import streaming
+
+        if not jpeg_native_available():
+            pytest.skip("no g++/libjpeg toolchain")
+        rng = np.random.default_rng(2)
+        blob = self._jpeg(self._smooth(rng, 48, 48))
+        monkeypatch.setattr(streaming, "_JPEG_DECODER", "unset")
+        out = streaming._dec_image(blob)
+        assert streaming._JPEG_DECODER is not None  # fast path engaged
+        np.testing.assert_array_equal(out, self._pil_decode(blob))
+        # PNG bytes bypass the jpeg path entirely
+        import io
+
+        from PIL import Image
+
+        png = io.BytesIO()
+        Image.fromarray(self._smooth(rng, 24, 24)).save(png, "PNG")
+        np.testing.assert_array_equal(
+            streaming._dec_image(png.getvalue()),
+            self._pil_decode(png.getvalue()),
+        )
+
+    def test_kill_switch_disables_native_path(self, monkeypatch):
+        from tpuframe.data import streaming
+
+        monkeypatch.setenv("TPUFRAME_NATIVE_JPEG", "0")
+        monkeypatch.setattr(streaming, "_JPEG_DECODER", "unset")
+        rng = np.random.default_rng(3)
+        blob = self._jpeg(self._smooth(rng, 32, 32))
+        out = streaming._dec_image(blob)
+        assert streaming._JPEG_DECODER is None  # native path disabled
+        np.testing.assert_array_equal(out, self._pil_decode(blob))

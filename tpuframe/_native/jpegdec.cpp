@@ -1,0 +1,185 @@
+// Batch JPEG decoder for the image input pipeline.
+//
+// Role (SURVEY.md §2.3 / §7): "input pipeline feeding HBM at ImageNet
+// rate" — the v5e chip consumes ~2.2k 224px images/sec (PERF.md) and the
+// host must decode that fast.  Pillow's decoders hold the GIL, so python
+// thread workers cannot scale JPEG decode across cores; these entry
+// points run libjpeg(-turbo) with the GIL released (ctypes calls drop
+// it) and fan a batch across a thread pool, same shape as the zstd batch
+// codec (codec.cpp).
+//
+// Decode policy: grayscale JPEGs decode to 1 channel, everything else to
+// RGB (libjpeg converts YCbCr; exotic spaces like CMYK fail the item and
+// the python wrapper falls back to PIL for it).
+//
+// Build: g++ -O2 -shared -fPIC jpegdec.cpp -o libtfjpeg.so -ljpeg -lpthread
+// (tpuframe.core.native compiles this lazily and caches the .so).
+
+#include <cstddef>  // jpeglib.h uses size_t/FILE without including them
+#include <cstdio>
+
+#include <jerror.h>
+#include <jpeglib.h>
+
+#include <atomic>
+#include <csetjmp>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ErrJmp {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  ErrJmp* e = reinterpret_cast<ErrJmp*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+void silent_emit(j_common_ptr cinfo, int msg_level) {
+  // Keep quiet but keep COUNTING — and count only TRUNCATION-class
+  // warnings (premature EOF / hit marker / resync) as failures.  Benign
+  // warnings (extraneous bytes, spec quirks common in scraped data) must
+  // not fail the item: that would silently decode twice (full native
+  // scan, then the PIL fallback), inverting the fast path's advantage.
+  if (msg_level < 0) {
+    int code = cinfo->err->msg_code;
+    if (code == JWRN_JPEG_EOF || code == JWRN_HIT_MARKER ||
+        code == JWRN_MUST_RESYNC)
+      cinfo->err->num_warnings++;
+  }
+}
+void silent_output(j_common_ptr) {}
+
+// Parse one header; fills h, w, out_channels (post-policy: 1 or 3).
+// Returns 0 on success.
+int parse_header(const uint8_t* src, size_t size, int32_t* h, int32_t* w,
+                 int32_t* c) {
+  jpeg_decompress_struct cinfo;
+  ErrJmp err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = err_exit;
+  err.mgr.emit_message = silent_emit;
+  err.mgr.output_message = silent_output;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(src), size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = (int32_t)cinfo.image_height;
+  *w = (int32_t)cinfo.image_width;
+  *c = (cinfo.jpeg_color_space == JCS_GRAYSCALE) ? 1 : 3;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode one image into dst (capacity dims h*w*c from tfj_dims).
+// Returns 0 on success.
+int decode_one(const uint8_t* src, size_t size, uint8_t* dst, int32_t exp_h,
+               int32_t exp_w, int32_t exp_c) {
+  jpeg_decompress_struct cinfo;
+  ErrJmp err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = err_exit;
+  err.mgr.emit_message = silent_emit;
+  err.mgr.output_message = silent_output;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(src), size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space =
+      (cinfo.jpeg_color_space == JCS_GRAYSCALE) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  // the caller allocated from tfj_dims; a mismatch (corrupt/substituted
+  // bytes) must never overflow the buffer
+  if ((int32_t)cinfo.output_height != exp_h ||
+      (int32_t)cinfo.output_width != exp_w ||
+      (int32_t)cinfo.output_components != exp_c) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  const size_t stride = (size_t)exp_w * (size_t)exp_c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = dst + (size_t)cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  // libjpeg treats truncated streams as WARNINGS and silently pads the
+  // image with dummy data; strict mode (PIL parity: truncated images
+  // raise) fails the item when silent_emit counted a truncation-class
+  // warning
+  const long warnings = cinfo.err->num_warnings;
+  jpeg_destroy_decompress(&cinfo);
+  return warnings > 0 ? -1 : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Header pass: dims[i*3 + 0/1/2] = height, width, channels (1 or 3).
+// Returns 0 on success; otherwise (1 + index) of the first bad item.
+int tfj_dims(const uint8_t** srcs, const size_t* sizes, int n,
+             int32_t* dims) {
+  for (int i = 0; i < n; ++i) {
+    if (parse_header(srcs[i], sizes[i], &dims[i * 3], &dims[i * 3 + 1],
+                     &dims[i * 3 + 2]) != 0)
+      return 1 + i;
+  }
+  return 0;
+}
+
+// Decode n images on a thread pool into caller-allocated buffers sized
+// from tfj_dims.  Returns 0 on success; otherwise (1 + index) of the
+// first failing item (remaining items may be skipped).
+int tfj_decode_batch(const uint8_t** srcs, const size_t* sizes,
+                     uint8_t** dsts, const int32_t* dims, int n,
+                     int n_threads) {
+  if (n <= 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+
+  std::atomic<int> next(0);
+  std::atomic<int> failed(0);
+
+  auto worker = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n || failed.load() != 0) return;
+      if (decode_one(srcs[i], sizes[i], dsts[i], dims[i * 3],
+                     dims[i * 3 + 1], dims[i * 3 + 2]) != 0) {
+        int expect = 0;
+        failed.compare_exchange_strong(expect, 1 + i);
+        return;
+      }
+    }
+  };
+
+  if (n_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failed.load();
+}
+
+}  // extern "C"

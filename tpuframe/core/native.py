@@ -1,11 +1,16 @@
 """Native (C++) extensions: lazy g++ build + ctypes bindings.
 
-Two components (SURVEY.md §2.3 — the native layers the reference consumes
-from its dependency stack):
+Three components (SURVEY.md §2.3 — the native layers the reference
+consumes from its dependency stack):
 
 - :class:`ZstdCodec` — batch shard decompression on a GIL-free thread pool
   (``tpuframe/_native/codec.cpp``), the mosaicml-streaming-native-codec
   equivalent feeding the TFS streaming reader.
+- :class:`JpegDecoder` — batch JPEG decode via libjpeg(-turbo) on the
+  same thread-pool shape (``tpuframe/_native/jpegdec.cpp``).  Pillow's
+  decoders hold the GIL, capping thread-worker decode at ~1 core; this
+  path scales across cores toward the chip's ~2.2k img/s ingest
+  (SURVEY §7 "input pipeline feeding HBM", PERF.md sizing).
 - :class:`ControlPlane` — TCP rendezvous + barrier/broadcast/allgather of
   host-side byte payloads (``tpuframe/_native/controlplane.cpp``), the
   c10d/torchrun control surface (run-id broadcast, pre-jax rendezvous).
@@ -82,6 +87,77 @@ def _codec_lib():
 def native_available() -> bool:
     """True when the C++ codec built (toolchain + libzstd present)."""
     return _codec_lib() is not None
+
+
+def _jpeg_lib():
+    lib = _build_and_load("tfjpeg", "jpegdec.cpp", ["jpeg"])
+    if lib is not None and not getattr(lib, "_tf_sigs", False):
+        pp = ctypes.POINTER(ctypes.c_char_p)
+        lib.tfj_dims.restype = ctypes.c_int
+        lib.tfj_dims.argtypes = [
+            pp, ctypes.POINTER(ctypes.c_size_t), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.tfj_decode_batch.restype = ctypes.c_int
+        lib.tfj_decode_batch.argtypes = [
+            pp, ctypes.POINTER(ctypes.c_size_t), pp,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+        ]
+        lib._tf_sigs = True
+    return lib
+
+
+def jpeg_native_available() -> bool:
+    """True when the C++ JPEG decoder built (toolchain + libjpeg)."""
+    return _jpeg_lib() is not None
+
+
+class JpegDecoder:
+    """Batch JPEG decode backed by libjpeg(-turbo) on a C++ thread pool.
+
+    Returns HWC uint8 arrays — RGB for color images, HW for grayscale
+    (matching PIL's ``np.asarray(Image.open(...))`` shapes so the two
+    decode paths are drop-in interchangeable).  Exotic color spaces
+    (CMYK/YCCK) fail the item; callers fall back to PIL for those.
+    """
+
+    def __init__(self, n_threads: int | None = None):
+        self._lib = _jpeg_lib()
+        if self._lib is None:
+            raise RuntimeError("native jpeg decoder unavailable (no g++/libjpeg)")
+        self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+
+    def decode_batch(self, blobs: Sequence[bytes]) -> list:
+        """Decode many JPEGs in one GIL-free C call."""
+        import numpy as np
+
+        n = len(blobs)
+        if n == 0:
+            return []
+        src_arr = (ctypes.c_char_p * n)(*blobs)
+        src_p = ctypes.cast(src_arr, ctypes.POINTER(ctypes.c_char_p))
+        sizes = (ctypes.c_size_t * n)(*[len(b) for b in blobs])
+        dims = (ctypes.c_int32 * (3 * n))()
+        rc = self._lib.tfj_dims(src_p, sizes, n, dims)
+        if rc != 0:
+            raise ValueError(f"invalid JPEG header at item {rc - 1}")
+        outs = []
+        for i in range(n):
+            h, w, c = dims[3 * i], dims[3 * i + 1], dims[3 * i + 2]
+            shape = (h, w, 3) if c == 3 else (h, w)
+            outs.append(np.empty(shape, np.uint8))
+        dst_arr = (ctypes.c_void_p * n)(*[out.ctypes.data for out in outs])
+        rc = self._lib.tfj_decode_batch(
+            src_p, sizes,
+            ctypes.cast(dst_arr, ctypes.POINTER(ctypes.c_char_p)),
+            dims, n, self.n_threads,
+        )
+        if rc != 0:
+            raise ValueError(f"JPEG decode failed at item {rc - 1}")
+        return outs
+
+    def decode(self, blob: bytes):
+        return self.decode_batch([blob])[0]
 
 
 class ZstdCodec:

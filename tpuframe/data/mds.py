@@ -92,11 +92,11 @@ def _decode_pil(data: bytes) -> np.ndarray:
 
 
 def _decode_image_file(data: bytes) -> np.ndarray:
-    import io
+    # shares streaming's decode path (native libjpeg fast path, PIL
+    # fallback) so MDS jpeg columns get the same GIL-free decode
+    from tpuframe.data.streaming import _dec_image
 
-    from PIL import Image
-
-    return np.asarray(Image.open(io.BytesIO(data)))
+    return _dec_image(data)
 
 
 def _decode_value(encoding: str, data: bytes) -> Any:

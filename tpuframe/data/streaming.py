@@ -65,7 +65,39 @@ def _enc_image(fmt: str):
     return enc
 
 
+_JPEG_DECODER: Any = "unset"  # tri-state lazy singleton
+
+
+def _native_jpeg():
+    """The C++ libjpeg decoder, or None (no toolchain / disabled).
+
+    Measured 1.7x PIL single-thread AND GIL-free (Pillow's decoders hold
+    the GIL, capping thread-worker scaling at ~1 core); built once,
+    n_threads=1 because the DataLoader's worker pool already provides the
+    parallelism — a nested pool would oversubscribe.  Kill switch:
+    ``TPUFRAME_NATIVE_JPEG=0``.
+    """
+    global _JPEG_DECODER
+    if _JPEG_DECODER == "unset":
+        _JPEG_DECODER = None
+        if os.environ.get("TPUFRAME_NATIVE_JPEG", "1") != "0":
+            try:
+                from tpuframe.core.native import JpegDecoder
+
+                _JPEG_DECODER = JpegDecoder(n_threads=1)
+            except Exception:
+                _JPEG_DECODER = None
+    return _JPEG_DECODER
+
+
 def _dec_image(v: bytes) -> np.ndarray:
+    if v[:2] == b"\xff\xd8":  # JPEG magic
+        dec = _native_jpeg()
+        if dec is not None:
+            try:
+                return dec.decode(v)
+            except ValueError:
+                pass  # exotic color space (CMYK/YCCK) -> PIL handles it
     from PIL import Image
 
     return np.asarray(Image.open(io.BytesIO(v)))
