@@ -327,3 +327,88 @@ class TestJpegDecoder:
         out = streaming._dec_image(blob)
         assert streaming._JPEG_DECODER is None  # native path disabled
         np.testing.assert_array_equal(out, self._pil_decode(blob))
+
+    def test_scaled_decode_covers_target_never_upscales(self):
+        from tpuframe.core.native import JpegDecoder, jpeg_native_available
+
+        if not jpeg_native_available():
+            pytest.skip("no g++/libjpeg toolchain")
+        rng = np.random.default_rng(4)
+        blob = self._jpeg(self._smooth(rng, 256, 256))
+        dec = JpegDecoder()
+        assert dec.decode(blob, min_hw=(224, 224)).shape == (224, 224, 3)
+        assert dec.decode(blob, min_hw=(64, 64)).shape == (64, 64, 3)
+        assert dec.decode(blob, min_hw=(57, 57)).shape == (64, 64, 3)
+        # never upscaled beyond the file's own size
+        assert dec.decode(blob, min_hw=(999, 999)).shape == (256, 256, 3)
+        # non-multiple-of-8 source: ceil(250 * 7/8) = 219 < 224 -> 8/8
+        blob2 = self._jpeg(self._smooth(rng, 250, 250))
+        assert dec.decode(blob2, min_hw=(224, 224)).shape == (250, 250, 3)
+
+    def test_scaled_decode_matches_pil_draft(self):
+        """PIL's draft mode drives the same libjpeg DCT scaling, so the
+        1/2-scale outputs should agree (+/-1 LSB across lineages)."""
+        import io
+
+        from PIL import Image
+
+        from tpuframe.core.native import JpegDecoder, jpeg_native_available
+
+        if not jpeg_native_available():
+            pytest.skip("no g++/libjpeg toolchain")
+        rng = np.random.default_rng(5)
+        blob = self._jpeg(self._smooth(rng, 256, 256))
+        out = JpegDecoder().decode(blob, min_hw=(128, 128))
+        img = Image.open(io.BytesIO(blob))
+        img.draft(None, (128, 128))
+        ref = np.asarray(img)
+        assert out.shape == ref.shape == (128, 128, 3)
+        diff = np.abs(out.astype(np.int16) - ref.astype(np.int16))
+        assert int(diff.max()) <= 1
+
+    def test_dataset_decode_min_hw_end_to_end(self, tmp_path):
+        """decode_min_hw on StreamingDataset/MDSDataset: the Resize
+        finisher sees an already-covering image and the final pixels
+        match the full-decode path closely (smooth content)."""
+        from tpuframe.data import MDSDataset, MDSWriter
+        from tpuframe.data.streaming import ShardWriter, StreamingDataset
+        from tpuframe.data.transforms import Compose, Resize
+
+        rng = np.random.default_rng(6)
+        imgs = [self._smooth(rng, 256, 256) for _ in range(6)]
+        tfs, mds = str(tmp_path / "tfs"), str(tmp_path / "mds")
+        with ShardWriter(tfs, columns={"image": "jpg", "label": "int"}) as w:
+            for i, im in enumerate(imgs):
+                w.write({"image": im, "label": i})
+        with MDSWriter(mds, {"image": "jpeg", "label": "int"}) as w:
+            for i, im in enumerate(imgs):
+                w.write({"image": im, "label": i})
+        t = Compose([Resize(64)])
+        for ds_scaled, ds_full in (
+            (StreamingDataset(tfs, transform=t, decode_min_hw=(64, 64)),
+             StreamingDataset(tfs, transform=t)),
+            (MDSDataset(mds, transform=t, decode_min_hw=(64, 64)),
+             MDSDataset(mds, transform=t)),
+        ):
+            for i in range(6):
+                a, la = ds_scaled[i]
+                b, lb = ds_full[i]
+                assert a.shape == b.shape == (64, 64, 3)
+                assert la == lb == i
+                # different resample chains (DCT-scale+bilinear vs pure
+                # bilinear): close on smooth content, not bit-equal
+                err = np.abs(a.astype(np.int16) - b.astype(np.int16)).mean()
+                assert err < 4.0, err
+
+    def test_decode_min_hw_survives_pickling(self, tmp_path):
+        import pickle
+
+        from tpuframe.data.streaming import ShardWriter, StreamingDataset
+
+        rng = np.random.default_rng(7)
+        out = str(tmp_path / "v")
+        with ShardWriter(out, columns={"image": "jpg", "label": "int"}) as w:
+            w.write({"image": self._smooth(rng, 128, 128), "label": 0})
+        ds = StreamingDataset(out, decode_min_hw=(32, 32))
+        clone = pickle.loads(pickle.dumps(ds))
+        assert clone[0][0].shape == (32, 32, 3)

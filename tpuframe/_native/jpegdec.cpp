@@ -56,10 +56,24 @@ void silent_emit(j_common_ptr cinfo, int msg_level) {
 }
 void silent_output(j_common_ptr) {}
 
-// Parse one header; fills h, w, out_channels (post-policy: 1 or 3).
-// Returns 0 on success.
-int parse_header(const uint8_t* src, size_t size, int32_t* h, int32_t* w,
-                 int32_t* c) {
+// Pick the smallest DCT scale M/8 (M in 1..8) whose output still covers
+// (min_h, min_w).  min_h/min_w <= 0 means full size (M = 8).  libjpeg
+// applies ceil(dim * M / 8).
+int pick_scale(uint32_t h, uint32_t w, int32_t min_h, int32_t min_w) {
+  if (min_h <= 0 || min_w <= 0) return 8;
+  for (int m = 1; m < 8; ++m) {
+    uint64_t sh = ((uint64_t)h * m + 7) / 8;
+    uint64_t sw = ((uint64_t)w * m + 7) / 8;
+    if (sh >= (uint64_t)min_h && sw >= (uint64_t)min_w) return m;
+  }
+  return 8;
+}
+
+// Parse one header; fills h, w, out_channels (post-policy: 1 or 3) at
+// the chosen M/8 DCT scale covering (min_h, min_w).  Returns 0 on
+// success.
+int parse_header(const uint8_t* src, size_t size, int32_t min_h,
+                 int32_t min_w, int32_t* h, int32_t* w, int32_t* c) {
   jpeg_decompress_struct cinfo;
   ErrJmp err;
   cinfo.err = jpeg_std_error(&err.mgr);
@@ -76,17 +90,22 @@ int parse_header(const uint8_t* src, size_t size, int32_t* h, int32_t* w,
     jpeg_destroy_decompress(&cinfo);
     return -1;
   }
-  *h = (int32_t)cinfo.image_height;
-  *w = (int32_t)cinfo.image_width;
+  cinfo.scale_num =
+      (unsigned)pick_scale(cinfo.image_height, cinfo.image_width, min_h, min_w);
+  cinfo.scale_denom = 8;
+  jpeg_calc_output_dimensions(&cinfo);
+  *h = (int32_t)cinfo.output_height;
+  *w = (int32_t)cinfo.output_width;
   *c = (cinfo.jpeg_color_space == JCS_GRAYSCALE) ? 1 : 3;
   jpeg_destroy_decompress(&cinfo);
   return 0;
 }
 
-// Decode one image into dst (capacity dims h*w*c from tfj_dims).
-// Returns 0 on success.
-int decode_one(const uint8_t* src, size_t size, uint8_t* dst, int32_t exp_h,
-               int32_t exp_w, int32_t exp_c) {
+// Decode one image into dst (capacity dims h*w*c from tfj_dims), at the
+// same M/8 scale tfj_dims chose for (min_h, min_w).  Returns 0 on
+// success.
+int decode_one(const uint8_t* src, size_t size, uint8_t* dst, int32_t min_h,
+               int32_t min_w, int32_t exp_h, int32_t exp_w, int32_t exp_c) {
   jpeg_decompress_struct cinfo;
   ErrJmp err;
   cinfo.err = jpeg_std_error(&err.mgr);
@@ -105,6 +124,9 @@ int decode_one(const uint8_t* src, size_t size, uint8_t* dst, int32_t exp_h,
   }
   cinfo.out_color_space =
       (cinfo.jpeg_color_space == JCS_GRAYSCALE) ? JCS_GRAYSCALE : JCS_RGB;
+  cinfo.scale_num =
+      (unsigned)pick_scale(cinfo.image_height, cinfo.image_width, min_h, min_w);
+  cinfo.scale_denom = 8;
   jpeg_start_decompress(&cinfo);
   // the caller allocated from tfj_dims; a mismatch (corrupt/substituted
   // bytes) must never overflow the buffer
@@ -133,24 +155,27 @@ int decode_one(const uint8_t* src, size_t size, uint8_t* dst, int32_t exp_h,
 
 extern "C" {
 
-// Header pass: dims[i*3 + 0/1/2] = height, width, channels (1 or 3).
-// Returns 0 on success; otherwise (1 + index) of the first bad item.
+// Header pass: dims[i*3 + 0/1/2] = height, width, channels (1 or 3) at
+// the M/8 DCT scale covering (min_h, min_w); min_h/min_w <= 0 = full
+// size.  Returns 0 on success; otherwise (1 + index) of the first bad
+// item.
 int tfj_dims(const uint8_t** srcs, const size_t* sizes, int n,
-             int32_t* dims) {
+             int32_t min_h, int32_t min_w, int32_t* dims) {
   for (int i = 0; i < n; ++i) {
-    if (parse_header(srcs[i], sizes[i], &dims[i * 3], &dims[i * 3 + 1],
-                     &dims[i * 3 + 2]) != 0)
+    if (parse_header(srcs[i], sizes[i], min_h, min_w, &dims[i * 3],
+                     &dims[i * 3 + 1], &dims[i * 3 + 2]) != 0)
       return 1 + i;
   }
   return 0;
 }
 
 // Decode n images on a thread pool into caller-allocated buffers sized
-// from tfj_dims.  Returns 0 on success; otherwise (1 + index) of the
-// first failing item (remaining items may be skipped).
+// from tfj_dims (same min_h/min_w!).  Returns 0 on success; otherwise
+// (1 + index) of the first failing item (remaining items may be
+// skipped).
 int tfj_decode_batch(const uint8_t** srcs, const size_t* sizes,
                      uint8_t** dsts, const int32_t* dims, int n,
-                     int n_threads) {
+                     int32_t min_h, int32_t min_w, int n_threads) {
   if (n <= 0) return 0;
   if (n_threads < 1) n_threads = 1;
   if (n_threads > n) n_threads = n;
@@ -162,7 +187,7 @@ int tfj_decode_batch(const uint8_t** srcs, const size_t* sizes,
     for (;;) {
       int i = next.fetch_add(1);
       if (i >= n || failed.load() != 0) return;
-      if (decode_one(srcs[i], sizes[i], dsts[i], dims[i * 3],
+      if (decode_one(srcs[i], sizes[i], dsts[i], min_h, min_w, dims[i * 3],
                      dims[i * 3 + 1], dims[i * 3 + 2]) != 0) {
         int expect = 0;
         failed.compare_exchange_strong(expect, 1 + i);

@@ -91,15 +91,17 @@ def _decode_pil(data: bytes) -> np.ndarray:
     return np.asarray(img)
 
 
-def _decode_image_file(data: bytes) -> np.ndarray:
-    # shares streaming's decode path (native libjpeg fast path, PIL
-    # fallback) so MDS jpeg columns get the same GIL-free decode
+def _decode_image_file(data: bytes, min_hw: tuple | None = None) -> np.ndarray:
+    # shares streaming's decode path (native libjpeg fast path with fused
+    # decode-at-scale, PIL fallback) so MDS jpeg columns get the same
+    # GIL-free decode
     from tpuframe.data.streaming import _dec_image
 
-    return _dec_image(data)
+    return _dec_image(data, min_hw=min_hw)
 
 
-def _decode_value(encoding: str, data: bytes) -> Any:
+def _decode_value(encoding: str, data: bytes,
+                  min_hw: tuple | None = None) -> Any:
     if encoding in _SCALARS:
         return np.frombuffer(data, dtype=_SCALARS[encoding])[0].item()
     if encoding == "str":
@@ -109,7 +111,7 @@ def _decode_value(encoding: str, data: bytes) -> Any:
     if encoding == "pil":
         return _decode_pil(data)
     if encoding in ("jpeg", "png", "jpeg_array"):
-        return _decode_image_file(data)
+        return _decode_image_file(data, min_hw=min_hw)
     raise ValueError(
         f"unsupported MDS column encoding {encoding!r}; supported: "
         f"{sorted(_SCALARS) + ['str', 'bytes', 'pil', 'jpeg', 'png']}"
@@ -117,7 +119,8 @@ def _decode_value(encoding: str, data: bytes) -> Any:
 
 
 def _decode_sample(
-    data: bytes, names: list[str], encodings: list[str], sizes: list[int | None]
+    data: bytes, names: list[str], encodings: list[str],
+    sizes: list[int | None], min_hw_cols: Mapping[str, tuple] | None = None,
 ) -> dict:
     # one uint32 per variable-width column leads the sample, in order
     widths: list[int] = []
@@ -131,7 +134,10 @@ def _decode_sample(
     out = {}
     pos = head
     for name, encoding, width in zip(names, encodings, widths):
-        out[name] = _decode_value(encoding, data[pos : pos + width])
+        out[name] = _decode_value(
+            encoding, data[pos : pos + width],
+            min_hw=(min_hw_cols or {}).get(name),
+        )
         pos += width
     return out
 
@@ -366,6 +372,7 @@ class MDSDataset:
         keep_decoded_shards: int = 2,
         fetcher: Callable[[str, str], None] = _default_fetcher,
         rng_seed: int = 0,
+        decode_min_hw: tuple | None = None,
     ):
         self.remote = remote
         # normalized so the evict-on-corruption guard's prefix compare
@@ -379,6 +386,13 @@ class MDSDataset:
         self.label_key = label_key
         self.fetcher = fetcher
         self.rng_seed = rng_seed
+        #: fused decode-at-scale hint for the image column (jpeg/png
+        #: encodings; jpeg decodes at the covering M/8 DCT scale) — see
+        #: ``streaming._dec_image``.  Pair with a Resize finisher.
+        self.decode_min_hw = (
+            (int(decode_min_hw[0]), int(decode_min_hw[1]))
+            if decode_min_hw is not None else None
+        )
         self.epoch = 0
 
         index_path = os.path.join(remote, INDEX_NAME)
@@ -591,6 +605,10 @@ class MDSDataset:
             entry["column_names"],
             entry["column_encodings"],
             entry["column_sizes"],
+            min_hw_cols=(
+                {self.image_key: self.decode_min_hw}
+                if self.decode_min_hw is not None else None
+            ),
         )
 
     def __getitem__(self, idx: int):
