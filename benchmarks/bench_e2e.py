@@ -107,6 +107,10 @@ def main() -> None:
                     help="ship raw uint8 over host->HBM and normalize "
                     "on-device (fused kernel) instead of host-side f32 — "
                     "4x less PCIe traffic and no host normalize cost")
+    ap.add_argument("--source-size", type=int, default=None,
+                    help="stored JPEG size (default ~8/7 of --size: "
+                    "sources larger than the train size, the ImageNet "
+                    "reality, exercising the fused decode-at-scale path)")
     args = ap.parse_args()
 
     from bench import (
@@ -144,11 +148,12 @@ def main() -> None:
     # enough images that the timed window spans >=2 epochs at most (decode
     # cache effects show up, volume build stays bounded)
     n_images = args.images or max(batch * 4, min(batch * (steps + 4), 4096))
+    src_size = args.source_size or -(-size * 8 // 7)
     vol = args.volume_dir or os.path.join(
         os.environ.get("TMPDIR", "/tmp"),
-        f"tpuframe_e2e_{args.format}_{size}px_{n_images}",
+        f"tpuframe_e2e_{args.format}_{src_size}to{size}px_{n_images}",
     )
-    build_volume(vol, args.format, n_images, size)
+    build_volume(vol, args.format, n_images, src_size)
 
     # --- model + step: identical shape to bench.py's headline ----------
     plan = ParallelPlan(mesh=MeshSpec(data=-1).build())
@@ -202,14 +207,18 @@ def main() -> None:
         transform = Compose([Resize(size), RandomHorizontalFlip()])
     else:
         transform = default_image_transforms(size)
+    # fused decode-at-scale: decode covers (size, size) straight out of
+    # the IDCT; the transform's Resize is the exact-size finisher
     if args.format == "mds":
         from tpuframe.data.mds import MDSDataset
 
-        ds = MDSDataset(vol, transform=transform)
+        ds = MDSDataset(vol, transform=transform,
+                        decode_min_hw=(size, size))
     else:
         from tpuframe.data.streaming import StreamingDataset
 
-        ds = StreamingDataset(vol, transform=transform)
+        ds = StreamingDataset(vol, transform=transform,
+                              decode_min_hw=(size, size))
     loader = DataLoader(
         ds, batch_size=batch, shuffle=True, seed=0,
         num_workers=workers, worker_mode=args.worker_mode,
@@ -274,6 +283,7 @@ def main() -> None:
         "worker_mode": args.worker_mode,
         "uint8_input": args.uint8_input,
         "images_in_volume": n_images,
+        "source_size": src_size,
     }))
 
 
