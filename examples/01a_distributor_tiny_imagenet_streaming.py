@@ -135,6 +135,11 @@ def train_tiny_imagenet(cfg: dict):
         Normalize(IMAGENET_MEAN, IMAGENET_STD),
     ])
     eval_tf = Compose([ToFloat(), Normalize(IMAGENET_MEAN, IMAGENET_STD)])
+    # NOTE for jpg-column volumes (this example's shards store ndarray
+    # columns): pass decode_min_hw=(px, px) AND lead the transform with
+    # Resize(px) — jpeg then decodes at the covering M/8 DCT scale
+    # (fused decode+resize, GIL-free) and Resize finishes the exact size;
+    # benchmarks/bench_e2e.py pairs the two correctly.
     train_ds = StreamingDataset(
         cfg["train_remote"],
         local_cache=os.path.join(local_cache, "train"),

@@ -50,7 +50,8 @@ def _build_and_load(name: str, source: str, extra_libs: Sequence[str]) -> ctypes
                 digest = hashlib.sha256(f.read()).hexdigest()[:16]
             os.makedirs(_BUILD_DIR, exist_ok=True)
             so_path = os.path.join(_BUILD_DIR, f"lib{name}.{digest}.so")
-            if not os.path.exists(so_path):
+
+            def build() -> None:
                 tmp = f"{so_path}.tmp.{os.getpid()}"
                 cmd = [
                     "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
@@ -60,7 +61,28 @@ def _build_and_load(name: str, source: str, extra_libs: Sequence[str]) -> ctypes
                     cmd, check=True, capture_output=True, timeout=120
                 )
                 os.replace(tmp, so_path)  # atomic vs. concurrent builders
-            lib = ctypes.CDLL(so_path)
+                # stale hash-keyed builds are dead weight; deleting is
+                # safe on Linux even if an older process still has one
+                # dlopened (a not-yet-dlopened process retries below)
+                prefix = f"lib{name}."
+                for old in os.listdir(_BUILD_DIR):
+                    if (old.startswith(prefix) and old.endswith(".so")
+                            and old != os.path.basename(so_path)):
+                        try:
+                            os.remove(os.path.join(_BUILD_DIR, old))
+                        except OSError:
+                            pass
+
+            if not os.path.exists(so_path):
+                build()
+            try:
+                lib = ctypes.CDLL(so_path)
+            except OSError:
+                # a concurrent newer-source process's cleanup may have
+                # unlinked our digest between the exists-check and dlopen;
+                # rebuild from OUR source and retry once
+                build()
+                lib = ctypes.CDLL(so_path)
         except Exception:
             lib = None
         _LIBS[name] = lib

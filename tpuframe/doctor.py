@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import shutil
 import os
 import subprocess
 import sys
@@ -89,7 +90,11 @@ def report(probe_timeout_s: float = 30.0) -> dict:
         "devices": devices,
         "mesh_hint": mesh_hint,
         "native_extensions": {
-            "toolchain_available": native.native_available(),
+            # toolchain probed independently of the codecs so "g++ there
+            # but libzstd/libjpeg missing" reads as exactly that
+            "toolchain_available": shutil.which("g++") is not None,
+            "zstd_codec": native.native_available(),
+            "jpeg_decoder": native.jpeg_native_available(),
             "built": built,
         },
         "optional_deps": {
