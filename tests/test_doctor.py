@@ -21,7 +21,10 @@ def test_report_shape_on_cpu(monkeypatch):
     assert rec["devices"]["backend"] == "cpu"
     assert rec["devices"]["device_count"] >= 1
     assert "mesh_hint" in rec and "DP" in rec["mesh_hint"]
-    assert isinstance(rec["native_extensions"]["built"], list)
+    nat = rec["native_extensions"]
+    assert isinstance(nat["built"], list)
+    for key in ("toolchain_available", "zstd_codec", "jpeg_decoder"):
+        assert isinstance(nat[key], bool), key
     assert rec["optional_deps"]["msgpack"]  # hard dep, must resolve
 
 
