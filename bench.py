@@ -363,19 +363,46 @@ def main() -> None:
     attempts: list[dict] = []
 
     def note(rung: str, kind: str, verdict: str, detail: str = "") -> None:
-        attempts.append(
-            {
-                "rung": rung,
-                "kind": kind,
-                "verdict": verdict,
-                "detail": detail[-300:] if detail else "",
-                "t_s": round(time.monotonic() - t_start, 1),
-            }
-        )
+        entry = {
+            "rung": rung,
+            "kind": kind,
+            "verdict": verdict,
+            "detail": detail[-300:] if detail else "",
+            "t_s": round(time.monotonic() - t_start, 1),
+        }
+        attempts.append(entry)
+        # Mirror every attempt into the telemetry JSONL as it happens
+        # (kind="bench_attempt", same fields as the record's `attempts`
+        # list) so the event log and BENCH_*.json agree — and a ladder the
+        # driver kills mid-flight still leaves its attempt trail on disk.
+        # telemetry is stdlib-only (track/__init__ resolves lazily): the
+        # bench parent stays jax-free, and telemetry failures never cost a
+        # bench record.
+        try:
+            from tpuframe.track.telemetry import get_telemetry
+
+            # the entry's own "kind" (preflight vs bench) is renamed: the
+            # event envelope already uses "kind" for the record type
+            fields = {("attempt_kind" if k == "kind" else k): v
+                      for k, v in entry.items()}
+            get_telemetry().event("bench/attempt", kind="bench_attempt", **fields)
+        except Exception:
+            pass
 
     def emit(rec: dict, fallback_reason: str | None) -> None:
         rec["fallback_reason"] = fallback_reason
         rec["attempts"] = attempts
+        try:
+            from tpuframe.track.telemetry import get_telemetry
+
+            get_telemetry().event(
+                "bench/record", kind="bench_record",
+                metric=rec.get("metric"), value=rec.get("value"),
+                backend=rec.get("backend"),
+                fallback_reason=fallback_reason, n_attempts=len(attempts),
+            )
+        except Exception:
+            pass
         print(json.dumps(rec))
 
     def budget(reserve: float = 150.0) -> float:

@@ -14,6 +14,7 @@ from tpuframe.core.runtime import MeshSpec
 from tpuframe.parallel import ParallelPlan
 from tpuframe.parallel.compression import quantized_pmean
 from tpuframe.train import create_train_state, make_train_step
+from tpuframe.core.runtime import shard_map
 
 
 def _mesh(n=8):
@@ -33,7 +34,7 @@ def test_quantized_pmean_close_to_exact():
     def qmean(t):
         return quantized_pmean(t, ("data",))
 
-    out = jax.shard_map(
+    out = shard_map(
         qmean, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
         check_vma=False,
     )(tree)
@@ -51,7 +52,7 @@ def test_quantized_pmean_close_to_exact():
 
 def test_quantized_pmean_zero_grads_no_nan():
     mesh = _mesh()
-    out = jax.shard_map(
+    out = shard_map(
         lambda t: quantized_pmean(t, ("data",)),
         mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False,
     )({"g": jnp.zeros((8, 16), jnp.float32)})
@@ -128,7 +129,7 @@ def test_nonfinite_grads_surface_as_nan():
     silently quantized to zeros, so divergence detection still fires."""
     mesh = _mesh()
     tree = {"g": jnp.full((8, 4), jnp.inf, jnp.float32)}
-    out = jax.shard_map(
+    out = shard_map(
         lambda t: quantized_pmean(t, ("data",)),
         mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False,
     )(tree)

@@ -1,0 +1,553 @@
+"""Telemetry spine: spans, metrics registry, JSONL log, stall watchdog.
+
+The acceptance contract this file demonstrates (ISSUE 1):
+
+- a deliberately-stalled CPU training step triggers a watchdog report
+  carrying all-thread stacks and the active span path within 2x the
+  configured deadline;
+- the Trainer's epoch summary still reports ``data_wait_s`` /
+  ``dispatch_s`` / ``host_block_s``, now derived from spans;
+- a 3-step CPU fit leaves ``train/step`` spans with non-negative
+  durations in the JSONL event log (the tier-1 smoke for the bench/CI
+  wiring).
+
+No test sleeps longer than ~1s; everything runs on the simulated-CPU
+platform from conftest.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpuframe.track import telemetry as T
+from tpuframe.track.watchdog import Watchdog
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    """Each test gets (and cleans up) its own process-wide instance."""
+    T.reset()
+    yield
+    T.reset()
+
+
+# -- spans --------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_records_stack_and_durations(self):
+        tele = T.configure()
+        with tele.span("outer") as so:
+            with tele.span("inner") as si:
+                time.sleep(0.01)
+            assert si.stack == ["outer", "inner"]
+        assert so.stack == ["outer"]
+        assert so.elapsed >= si.elapsed > 0
+        # both feed per-name histograms automatically
+        assert tele.registry.histogram("span/outer").count == 1
+        assert tele.registry.histogram("span/inner").count == 1
+
+    def test_exception_marks_span_failed_and_propagates(self):
+        tele = T.configure()
+        with pytest.raises(ValueError, match="boom"):
+            with tele.span("explodes") as sp:
+                raise ValueError("boom")
+        assert sp.ok is False
+        assert "ValueError" in sp.error
+        ev = [e for e in tele.recent_events() if e["name"] == "explodes"]
+        assert ev and ev[0]["ok"] is False and "ValueError" in ev[0]["error"]
+        # the failed span was popped: no stuck entry in the live stacks
+        assert tele.active_spans() == {}
+
+    def test_threads_have_independent_stacks(self):
+        tele = T.configure()
+        ready = threading.Barrier(3, timeout=5)
+        release = threading.Event()
+        seen: dict[str, list[str]] = {}
+
+        def run(name):
+            with tele.span(name):
+                ready.wait()
+                release.wait(timeout=5)
+
+        threads = [
+            threading.Thread(target=run, args=(f"t{i}",), name=f"spanner-{i}")
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        ready.wait()
+        seen = tele.active_spans()
+        release.set()
+        for t in threads:
+            t.join()
+        stacks = sorted(tuple(v) for k, v in seen.items() if "spanner" in k)
+        assert stacks == [("t0",), ("t1",)]  # no cross-thread mixing
+        assert tele.active_spans() == {}
+
+    def test_emit_false_skips_event_but_keeps_histogram(self):
+        tele = T.configure()
+        with tele.span("quiet", emit=False):
+            pass
+        assert not [e for e in tele.recent_events() if e.get("name") == "quiet"]
+        assert tele.registry.histogram("span/quiet").count == 1
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+class TestRegistry:
+    def test_histogram_percentiles(self):
+        h = T.Histogram("h", max_samples=4096)
+        for v in range(1, 101):  # 1..100
+            h.observe(float(v))
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["mean"] == pytest.approx(50.5)
+        assert s["p50"] == 51.0  # index int(0.5*100) of sorted 1..100
+        assert s["p95"] == 96.0
+        assert s["p99"] == 100.0
+
+    def test_histogram_ring_keeps_recent_window(self):
+        # the old StepTimer bug inverted: lifetime totals keep counting,
+        # the percentile window holds the most RECENT max_samples
+        h = T.Histogram("h", max_samples=10)
+        for v in range(100):
+            h.observe(float(v))
+        assert h.count == 100
+        assert h.total == pytest.approx(sum(range(100)))
+        assert sorted(h.window()) == [float(v) for v in range(90, 100)]
+
+    def test_counter_gauge_snapshot(self):
+        reg = T.MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(7.5)
+        reg.histogram("h").observe(1.0)
+        snap = reg.snapshot(prefix="p/")
+        assert snap["p/c"] == 3.0
+        assert snap["p/g"] == 7.5
+        assert snap["p/h_count"] == 1.0 and snap["p/h_p50"] == 1.0
+
+    def test_prometheus_text(self):
+        reg = T.MetricsRegistry()
+        reg.counter("data/batches").inc(4)
+        reg.gauge("train/epoch").set(2)
+        reg.histogram("span/train/step").observe(0.5)
+        text = reg.prometheus_text()
+        assert "# TYPE tpuframe_data_batches counter" in text
+        assert "tpuframe_data_batches 4.0" in text
+        assert "tpuframe_train_epoch 2.0" in text
+        assert 'tpuframe_span_train_step{quantile="0.50"} 0.5' in text
+        assert "tpuframe_span_train_step_count 1" in text
+
+    def test_metrics_server_serves_registry(self):
+        tele = T.configure()
+        tele.registry.counter("hits").inc(3)
+        srv = T.start_metrics_server()
+        try:
+            body = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+            assert "tpuframe_hits 3.0" in body
+            health = urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/healthz", timeout=5
+            ).read()
+            assert json.loads(health)["status"] == "ok"
+        finally:
+            srv.close()
+
+
+# -- JSONL event log ----------------------------------------------------------
+
+
+class TestJsonl:
+    def test_schema_round_trip(self, tmp_path):
+        tele = T.configure(jsonl_dir=str(tmp_path), rank=2)
+        with tele.span("a", note="hi"):
+            pass
+        tele.event("custom", kind="bench_attempt", rung="accel", verdict="ok")
+        path = tmp_path / "events-rank2.jsonl"
+        assert tele.jsonl_path == str(path)
+        recs = [json.loads(line) for line in path.read_text().splitlines()]
+        assert len(recs) == 2
+        for rec in recs:  # the envelope every record carries
+            for key in ("v", "ts", "rank", "pid", "thread", "kind", "name"):
+                assert key in rec, key
+            assert rec["v"] == T.SCHEMA_VERSION
+            assert rec["rank"] == 2
+        span, ev = recs
+        assert span["kind"] == "span" and span["name"] == "a"
+        assert span["dur_s"] >= 0 and span["ok"] is True
+        assert span["stack"] == ["a"] and span["attrs"] == {"note": "hi"}
+        assert ev["kind"] == "bench_attempt" and ev["verdict"] == "ok"
+
+    def test_memory_only_without_configuration(self):
+        tele = T.configure()
+        with tele.span("x"):
+            pass
+        assert tele.jsonl_path is None
+        assert tele.recent_events()[-1]["name"] == "x"
+
+    def test_env_dir_is_picked_up(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("TPUFRAME_PROCESS_ID", "5")
+        T.reset()
+        tele = T.get_telemetry()
+        assert tele.jsonl_path == str(tmp_path / "events-rank5.jsonl")
+        assert tele.rank == 5
+
+
+# -- watchdog -----------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_stalled_activity_reports_within_2x_deadline(self, tmp_path):
+        deadline = 0.4
+        tele = T.configure(jsonl_dir=str(tmp_path), rank=0)
+        sink = io.StringIO()
+        wd = tele.attach_watchdog(Watchdog(default_deadline_s=deadline, sink=sink))
+
+        def stalled():
+            with tele.span("train/step"), tele.guard("train/step"):
+                time.sleep(2.4 * deadline)
+
+        t = threading.Thread(target=stalled, name="stalled-step")
+        t0 = time.monotonic()
+        t.start()
+        while not wd.reports and time.monotonic() - t0 < 3 * deadline:
+            time.sleep(0.02)
+        detected = time.monotonic() - t0
+        t.join()
+
+        assert wd.reports, "watchdog produced no stall report"
+        assert detected <= 2 * deadline, f"report took {detected:.2f}s"
+        rep = wd.reports[0]
+        assert rep["name"] == "train/step"
+        assert rep["overdue_s"] <= deadline  # i.e. within 2x overall
+        # the active span path of the stalled thread is in the report
+        assert any("train/step" in v for v in rep["spans"].values())
+        # all-thread python stacks, including the sleeping line
+        assert "stalled-step" in rep["stacks"]
+        assert "time.sleep" in rep["stacks"] or "sleep" in rep["stacks"]
+        # stderr-style report went to the sink
+        text = sink.getvalue()
+        assert "STALL 'train/step'" in text
+        assert "all-thread python stacks" in text
+        # ... and the JSONL log has the stall + the recovery marker
+        kinds = [
+            (e["kind"], e["name"])
+            for e in map(json.loads,
+                         (tmp_path / "events-rank0.jsonl").read_text().splitlines())
+        ]
+        assert ("stall", "train/step") in kinds
+        assert ("stall_recovered", "train/step") in kinds
+
+    def test_beat_defers_the_deadline(self):
+        tele = T.configure()
+        wd = tele.attach_watchdog(
+            Watchdog(default_deadline_s=0.3, sink=io.StringIO())
+        )
+        with wd.guard("loop") as g:
+            for _ in range(4):  # 0.6s of work, never >0.3s between beats
+                time.sleep(0.15)
+                g.beat()
+        assert not wd.reports
+
+    def test_stall_then_beat_still_records_recovery(self):
+        # a reported stall that later heartbeats and completes must still
+        # emit stall_recovered (ever_dumped is sticky; dumped re-arms)
+        tele = T.configure()
+        wd = tele.attach_watchdog(
+            Watchdog(default_deadline_s=0.15, sink=io.StringIO())
+        )
+        with wd.guard("bursty") as g:
+            time.sleep(0.3)  # stall: report fires
+            while not wd.reports:
+                time.sleep(0.02)
+            g.beat()  # recovers, re-arms
+        kinds = [e["kind"] for e in tele.recent_events()]
+        assert "stall" in kinds and "stall_recovered" in kinds
+
+    def test_stopped_watchdog_refuses_new_leases(self):
+        wd = Watchdog(default_deadline_s=5.0, sink=io.StringIO())
+        with wd.guard("a") as g:
+            assert g.monitored
+        wd.stop()
+        with wd.guard("a") as g:
+            assert not g.monitored  # no resurrection of the monitor thread
+        assert wd._thread is None
+
+    def test_unresolved_deadline_is_unmonitored(self):
+        tele = T.configure()
+        wd = tele.attach_watchdog(Watchdog(sink=io.StringIO()))  # no defaults
+        with wd.guard("anything") as g:
+            assert not g.monitored
+        with wd.guard("named", deadline_s=5.0) as g:
+            assert g.monitored
+
+    def test_deadline_resolution_order(self):
+        wd = Watchdog(default_deadline_s=10.0, deadlines={"a": 1.0})
+        assert wd.resolve_deadline("a", None) == 1.0
+        assert wd.resolve_deadline("b", None) == 10.0
+        assert wd.resolve_deadline("a", 3.0) == 3.0
+
+    def test_env_deadline_parsing(self):
+        assert T._parse_deadlines("train/step=120,ckpt/save=600") == {
+            "train/step": 120.0,
+            "ckpt/save": 600.0,
+        }
+        assert T._parse_deadlines("garbage,=,x=notafloat") == {}
+
+
+# -- trainer integration ------------------------------------------------------
+
+
+def _tiny_loader(n=64, batch=16):
+    from tpuframe.data import DataLoader, SyntheticImageDataset
+
+    ds = SyntheticImageDataset(n=n, num_classes=4, image_size=28, channels=1)
+    return DataLoader(ds, batch_size=batch, process_index=0, process_count=1)
+
+
+@pytest.fixture()
+def cpu_runtime():
+    from tpuframe.core import MeshSpec
+    from tpuframe.core import runtime as rt
+
+    rt.reset_runtime()
+    rt.initialize(MeshSpec(data=-1))
+    yield
+    rt.reset_runtime()
+
+
+class TestTrainerTelemetry:
+    def test_three_step_fit_leaves_step_spans_in_event_log(
+        self, tmp_path, cpu_runtime
+    ):
+        """The tier-1 smoke the CI satellite asks for: 3 steps on CPU, then
+        the JSONL event log holds train/step spans with non-negative
+        durations."""
+        from tpuframe.models import MnistNet
+        from tpuframe.train import Trainer
+
+        tele = T.configure(jsonl_dir=str(tmp_path), rank=0)
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=_tiny_loader(),
+            max_duration="3ba",
+            num_classes=4,
+        )
+        result = trainer.fit()
+
+        recs = [
+            json.loads(line)
+            for line in (tmp_path / "events-rank0.jsonl").read_text().splitlines()
+        ]
+        steps = [r for r in recs if r["kind"] == "span" and r["name"] == "train/step"]
+        assert len(steps) == 3
+        for s in steps:
+            assert s["dur_s"] >= 0 and s["ok"] is True
+            assert s["stack"][-1] == "train/step"
+        epochs = [r for r in recs if r["name"] == "train/epoch"]
+        assert epochs and epochs[0]["attrs"] == {"epoch": 0}
+        # per-step distributions come free via the registry
+        assert tele.registry.histogram("span/train/step").count == 3
+        assert tele.registry.counter("data/batches_prefetched").value >= 3
+        # the legacy wall-clock breakdown keys survive, span-derived now
+        for key in ("data_wait_s", "dispatch_s", "host_block_s", "epoch_time_s"):
+            assert key in result.metrics and result.metrics[key] >= 0
+        # components measured inside the epoch cannot exceed the epoch total
+        inside = (
+            result.metrics["data_wait_s"]
+            + result.metrics["dispatch_s"]
+            + result.metrics["host_block_s"]
+        )
+        assert inside <= result.metrics["epoch_time_s"] + 0.05
+        assert result.metrics["dispatch_s"] > 0
+
+    def test_stalled_train_step_triggers_watchdog_report(
+        self, tmp_path, cpu_runtime
+    ):
+        """ISSUE acceptance: a deliberately-stalled CPU training step
+        produces a stall report with all-thread stacks and the active span
+        path within 2x the configured deadline."""
+        from tpuframe.models import MnistNet
+        from tpuframe.train import Trainer
+
+        deadline = 0.4
+        tele = T.configure(
+            jsonl_dir=str(tmp_path),
+            rank=0,
+            watchdog=Watchdog(default_deadline_s=deadline, sink=io.StringIO()),
+        )
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=_tiny_loader(),
+            max_duration="1ba",
+            num_classes=4,
+        )
+        real_step = trainer._train_step
+
+        def stalled_step(state, batch):
+            time.sleep(2.4 * deadline)  # the deliberate stall
+            return real_step(state, batch)
+
+        trainer._train_step = stalled_step
+        trainer.fit()
+
+        wd = tele.watchdog
+        assert wd.reports, "stalled step produced no watchdog report"
+        rep = wd.reports[0]
+        assert rep["name"] == "train/step"
+        assert rep["overdue_s"] <= deadline  # detected within 2x deadline
+        span_paths = list(rep["spans"].values())
+        assert any(p[-2:] == ["train/epoch", "train/step"]
+                   or "train/step" in p for p in span_paths)
+        assert "stalled_step" in rep["stacks"]  # the wedged frame, named
+        stalls = [
+            json.loads(line)
+            for line in (tmp_path / "events-rank0.jsonl").read_text().splitlines()
+            if json.loads(line)["kind"] == "stall"
+        ]
+        assert stalls and stalls[0]["name"] == "train/step"
+
+    def test_metrics_export_callback_bridges_registry_to_loggers(
+        self, cpu_runtime
+    ):
+        from tpuframe.models import MnistNet
+        from tpuframe.train import Trainer
+
+        T.configure()
+
+        class CaptureLogger:
+            def __init__(self):
+                self.metrics: list[dict] = []
+
+            def log_metrics(self, metrics, step=0):
+                self.metrics.append(dict(metrics))
+
+        cap = CaptureLogger()
+        trainer = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=_tiny_loader(),
+            max_duration="2ba",
+            num_classes=4,
+            callbacks=[T.MetricsExportCallback()],
+            loggers=[cap],
+        )
+        trainer.fit()
+        bridged = [m for m in cap.metrics if any(k.startswith("telemetry/") for k in m)]
+        assert bridged, "no telemetry/ snapshot reached the logger"
+        last = bridged[-1]
+        assert last["telemetry/span/train/step_count"] == 2.0
+        assert last["telemetry/span/train/step_p50"] >= 0
+
+
+# -- StepTimer ring (satellite) ----------------------------------------------
+
+
+class TestStepTimerRing:
+    def test_ring_keeps_sampling_past_max_samples(self):
+        from tpuframe.track.profiler import StepTimer
+
+        T.configure()
+        timer = StepTimer(max_samples=8)
+        for i in range(20):
+            timer.on_step_start(None)
+            timer._t0 -= 0.001 * (i + 1)  # synthesize increasing durations
+            timer.on_step_end(None)
+        s = timer.summary()
+        assert s["steps_seen"] == 20.0
+        assert s["steps_sampled"] == 8.0  # the ring, not the lifetime
+        # the window is the RECENT samples: all >= the 13th duration
+        assert min(timer.samples) >= 0.012
+        assert s["step_time_p99_s"] >= s["step_time_p50_s"]
+        # folded into the shared registry
+        reg = T.get_telemetry().registry
+        assert reg.histogram("callback/step_time_s").count == 20
+
+
+# -- doctor integration (satellite) ------------------------------------------
+
+
+class TestDoctorTelemetry:
+    def test_telemetry_section_shape(self, tmp_path):
+        from tpuframe import doctor
+
+        T.configure(
+            jsonl_dir=str(tmp_path),
+            rank=0,
+            watchdog=Watchdog(default_deadline_s=90.0, sink=io.StringIO()),
+        )
+        sec = doctor.telemetry_section()
+        assert sec["event_log"] == str(tmp_path / "events-rank0.jsonl")
+        assert "jsonl" in sec["exporters"]
+        assert sec["watchdog"]["active"] is True
+        assert sec["watchdog"]["default_deadline_s"] == 90.0
+
+    def test_wedged_probe_report_carries_wall_time(self, monkeypatch):
+        from tpuframe import doctor
+
+        T.configure()
+        monkeypatch.setattr(doctor, "_PROBE_SRC", "import time; time.sleep(60)")
+        rec = doctor.probe_devices(timeout_s=0.5)
+        assert "wedged" in rec["error"]
+        assert rec["probe_wall_s"] >= 0.5  # timing evidence rides along
+        ev = [
+            e for e in T.get_telemetry().recent_events()
+            if e.get("name") == "doctor/device_probe"
+        ]
+        assert ev and ev[0]["dur_s"] >= 0.5
+
+
+# -- bench integration (satellite) -------------------------------------------
+
+
+def test_bench_attempts_mirror_into_telemetry(monkeypatch, capsys):
+    """bench.py's ladder notes every attempt into the telemetry event log
+    with the same fields as the emitted record's `attempts` list."""
+    import importlib.util
+    import subprocess
+    import types
+
+    T.configure()
+    spec = importlib.util.spec_from_file_location(
+        "bench_telemetry_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    outcomes = ["hang", "ok-preflight", "ok-child"]
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        o = outcomes.pop(0)
+        if o == "hang":
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        if o == "ok-preflight":
+            return types.SimpleNamespace(
+                returncode=0, stdout="PREFLIGHT_OK tpu", stderr=""
+            )
+        return types.SimpleNamespace(
+            returncode=0, stdout=json.dumps({"metric": "m", "value": 1.0}),
+            stderr="",
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.os, "environ", {"JAX_PLATFORMS": "axon"})
+    bench.main()
+
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    events = T.get_telemetry().recent_events(100)
+    # the attempt's own "kind" rides as attempt_kind (the envelope owns "kind")
+    mirrored = [e for e in events if e["kind"] == "bench_attempt"]
+    # the JSONL trail and the emitted record's attempts list must agree
+    assert [
+        (e["rung"], e["attempt_kind"], e["verdict"]) for e in mirrored
+    ] == [(a["rung"], a["kind"], a["verdict"]) for a in rec["attempts"]]
+    assert [e for e in events if e["kind"] == "bench_record"]

@@ -23,6 +23,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tpuframe.track.telemetry import get_telemetry
+
 _DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
 
 
@@ -101,15 +103,20 @@ class Checkpointer:
             step = int(jax.device_get(_state_data(state).get("step", 0) or 0))
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         meta = dict(meta or {})
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(_state_data(state)),
-                meta=ocp.args.JsonSave({"meta": meta, "metrics": metrics}),
-            ),
-            metrics=metrics or None,
-            force=force,
-        )
+        # span + watchdog lease: a checkpoint write wedging on a dead
+        # filesystem or a stuck collective is one of the documented silent
+        # hangs — under a watchdog it becomes an attributed stall report
+        tele = get_telemetry()
+        with tele.span("ckpt/save", step=int(step)), tele.guard("ckpt/save"):
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_state_data(state)),
+                    meta=ocp.args.JsonSave({"meta": meta, "metrics": metrics}),
+                ),
+                metrics=metrics or None,
+                force=force,
+            )
         return os.path.join(self.directory, str(step))
 
     # -- restore -----------------------------------------------------------
@@ -126,13 +133,15 @@ class Checkpointer:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         template = _state_data(state)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        tele = get_telemetry()
+        with tele.span("ckpt/restore", step=int(step)), tele.guard("ckpt/restore"):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
         data, extra = restored["state"], restored.get("meta") or {}
         if isinstance(state, Mapping):
             return dict(data), dict(extra.get("meta", {}))

@@ -104,12 +104,15 @@ class MeshSpec:
         devices = list(devices) if devices is not None else jax.devices()
         sizes = self.resolve(len(devices))
         shape = tuple(sizes[name] for name in AXIS_ORDER)
-        auto = (jax.sharding.AxisType.Auto,) * len(AXIS_ORDER)
-        if devices == jax.devices():
+        try:
+            kw = {"axis_types": (jax.sharding.AxisType.Auto,) * len(AXIS_ORDER)}
+        except AttributeError:  # older jax: no AxisType — Auto is the only mode
+            kw = {}
+        if devices == jax.devices() and hasattr(jax, "make_mesh"):
             # jax.make_mesh picks an ICI-friendly physical ordering.
-            return jax.make_mesh(shape, AXIS_ORDER, axis_types=auto)
+            return jax.make_mesh(shape, AXIS_ORDER, **kw)
         grid = np.asarray(devices).reshape(shape)
-        return Mesh(grid, AXIS_ORDER, axis_types=auto)
+        return Mesh(grid, AXIS_ORDER, **kw)
 
     @classmethod
     def from_config(cls, cfg: Mapping[str, int]) -> "MeshSpec":
@@ -262,6 +265,34 @@ def reset_runtime() -> None:
         jax.config.update("jax_debug_nans", False)
         jax.config.update("jax_disable_most_optimizations", False)
         _DEBUG_FLAGS_SET = False
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` across the supported jax range: the public API
+    (jax >= 0.6, ``check_vma=`` kwarg) when present, else the experimental
+    one (same semantics, the kwarg was named ``check_rep``).  Call sites
+    pass ``f`` positionally and everything else by keyword."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as exp_shard_map
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return exp_shard_map(f, **kwargs)
+
+
+def named_axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside a shard_map body —
+    ``jax.lax.axis_size`` where it exists (jax >= 0.6), else the older
+    axis-env frame (which on the 0.4 line already resolves to the int)."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        import jax.core as jax_core
+
+        frame = jax_core.axis_frame(axis_name)
+        return int(getattr(frame, "size", frame))
 
 
 def process_index() -> int:

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from tpuframe.core import runtime as rt
+from tpuframe.track.telemetry import get_telemetry
 
 # Process-pool workers inherit the dataset via fork (copy-on-write — no
 # per-item pickling of the dataset, only of the returned samples).  A
@@ -424,8 +425,21 @@ class DevicePrefetcher:
             return False
 
         def worker():
+            # span emit=False: the histograms (span/data/prefetch_fetch vs
+            # span/data/prefetch_put = produce vs H2D cost) and the live
+            # span stack (a stalled pipeline shows THIS thread's position
+            # in a watchdog report) matter; a JSONL event per batch would
+            # not.
+            tele = get_telemetry()
+            prefetched = tele.registry.counter("data/batches_prefetched")
             try:
-                for batch in self.it:
+                it = iter(self.it)
+                while True:
+                    with tele.span("data/prefetch_fetch", emit=False):
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            break
                     # snapshot right after the pull: this is the position
                     # of exactly the batch being enqueued (pulling may
                     # advance the loader by several batches, e.g. the
@@ -435,7 +449,10 @@ class DevicePrefetcher:
                         if self.track_loader is not None
                         else None
                     )
-                    if not put((self._put(batch), snap)):
+                    with tele.span("data/prefetch_put", emit=False):
+                        device_batch = self._put(batch)
+                    prefetched.inc()
+                    if not put((device_batch, snap)):
                         return  # consumer went away
             except BaseException as e:  # propagate to consumer
                 err.append(e)

@@ -42,7 +42,21 @@ def _module_version(name: str) -> str | None:
 
 
 def probe_devices(timeout_s: float = 30.0) -> dict:
-    """Backend/topology via a bounded child (never hangs the doctor)."""
+    """Backend/topology via a bounded child (never hangs the doctor).
+
+    The probe runs under a telemetry span, and every outcome — including
+    the wedged-timeout path — carries ``probe_wall_s``: a wedged-probe
+    report should say how long the hang was given, not just that it hung.
+    """
+    from tpuframe.track.telemetry import get_telemetry
+
+    with get_telemetry().span("doctor/device_probe", timeout_s=timeout_s) as sp:
+        rec = _probe_devices(timeout_s)
+    rec["probe_wall_s"] = round(sp.elapsed, 3)
+    return rec
+
+
+def _probe_devices(timeout_s: float) -> dict:
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
@@ -64,6 +78,37 @@ def probe_devices(timeout_s: float = 30.0) -> dict:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
         return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
+
+
+def telemetry_section() -> dict:
+    """State of the telemetry spine (`tpuframe.track.telemetry`): where the
+    event log goes, whether a stall watchdog is armed, which exporters are
+    live — pasted into bug reports next to the device probe so a "wedged"
+    report also says what diagnostics were (or weren't) running."""
+    from tpuframe.track.telemetry import get_telemetry
+
+    tele = get_telemetry()
+    wd = tele.watchdog
+    exporters = ["memory_ring"]
+    if tele.jsonl_path:
+        exporters.append("jsonl")
+    return {
+        "event_log": tele.jsonl_path,
+        "events_buffered": len(tele.recent_events(10**9)),
+        "exporters": exporters,
+        "watchdog": {
+            "active": wd is not None,
+            "default_deadline_s": getattr(wd, "default_deadline_s", None),
+            "deadlines": dict(getattr(wd, "deadlines", {}) or {}),
+            "stalls_reported": len(getattr(wd, "reports", ())),
+        },
+        "env": {
+            k: os.environ[k]
+            for k in ("TPUFRAME_TELEMETRY_DIR", "TPUFRAME_WATCHDOG_S",
+                      "TPUFRAME_WATCHDOG_DEADLINES")
+            if k in os.environ
+        },
+    }
 
 
 def report(probe_timeout_s: float = 30.0) -> dict:
@@ -102,6 +147,7 @@ def report(probe_timeout_s: float = 30.0) -> dict:
             for name in ("zstandard", "PIL", "torch", "orbax.checkpoint",
                          "cloudpickle", "msgpack")
         },
+        "telemetry": telemetry_section(),
         "compile_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
         "env": {
             k: os.environ[k]

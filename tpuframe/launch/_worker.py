@@ -15,16 +15,28 @@ import sys
 def main(payload_path: str, result_path: str) -> None:
     import cloudpickle
 
-    # liveness beacon (before anything heavy: the driver should see this
-    # rank alive while jax imports grind)
-    from tpuframe.core.native import maybe_start_beacon
+    # Telemetry first — stdlib-only, env-configured (the Distributor's
+    # TPUFRAME_TELEMETRY_DIR/RANK env rides through), so a wedged
+    # bootstrap still leaves rank-tagged evidence.  The bootstrap guard is
+    # the launch-side stall tripwire: a hung rendezvous or jax import
+    # becomes an attributed watchdog report when TPUFRAME_WATCHDOG_S is on.
+    from tpuframe.track.telemetry import get_telemetry
 
-    maybe_start_beacon()
+    tele = get_telemetry()
+    with tele.span("launch/worker_bootstrap"), tele.guard("launch/worker_bootstrap"):
+        # liveness beacon (before anything heavy: the driver should see
+        # this rank alive while jax imports grind)
+        from tpuframe.core.native import maybe_start_beacon
 
-    with open(payload_path, "rb") as f:
-        fn, args, kwargs = cloudpickle.load(f)
+        maybe_start_beacon()
+
+        with open(payload_path, "rb") as f:
+            fn, args, kwargs = cloudpickle.load(f)
     try:
-        value = fn(*args, **kwargs)
+        # span only, no watchdog lease: the user fn runs unbounded —
+        # inner activities (steps, saves) carry their own guards
+        with tele.span("launch/worker_run"):
+            value = fn(*args, **kwargs)
         outcome = {"ok": True, "value": value}
     except BaseException as e:  # recorded, then re-raised
         try:

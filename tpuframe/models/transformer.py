@@ -35,6 +35,7 @@ from tpuframe.core.runtime import (
 from tpuframe.ops.ring_attention import attention_reference, ring_attention_local
 from tpuframe.ops.layer_norm import FusedLayerNorm
 from tpuframe.ops.ulysses import ulysses_attention_local
+from tpuframe.core.runtime import shard_map
 
 #: attn_impl="auto" switches full -> blockwise at this unsharded sequence
 #: length: 4k tokens is a 64 MB f32 score matrix PER (batch, head) — the
@@ -125,7 +126,7 @@ class SelfAttention(nn.Module):
                     and self.num_heads % mesh.shape[MODEL_AXIS] == 0
                 ) else None
             spec = P((DATA_AXIS, FSDP_AXIS), SEQUENCE_AXIS, head_axis, None)
-            out = jax.shard_map(
+            out = shard_map(
                 lambda q, k, v: local_fn(q, k, v, causal=self.causal),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),

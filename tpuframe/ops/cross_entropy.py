@@ -23,6 +23,7 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.ops.dispatch import batch_sharding_info, pad_to, resolve_interpret
+from tpuframe.core.runtime import shard_map
 
 _ROWS = 16  # rows per grid step; sublane-aligned for f32/bf16
 _LANES = 128
@@ -148,7 +149,7 @@ def fused_cross_entropy(
     if interpret is None:
         return cross_entropy_reference(logits, labels)
     if shardable and n_shards > 1:
-        return jax.shard_map(
+        return shard_map(
             lambda lg, lb: _fused(lg, lb, interpret),
             mesh=mesh,
             in_specs=(P(axes, None), P(axes)),

@@ -26,6 +26,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.ops.dispatch import pad_to, resolve_interpret
+from tpuframe.core.runtime import shard_map
 
 _LANES = 128
 _TILE_ROWS = 256
@@ -150,7 +151,7 @@ def fused_adamw_update(
     args = (step2, flat(p), flat(g), flat(m), flat(v))
     if shardable:
         spec2 = P(shard_axis, None)
-        po, mo, vo = jax.shard_map(
+        po, mo, vo = shard_map(
             lambda s, a, b, c, d: _pallas_update(s, a, b, c, d, hp, interpret),
             mesh=mesh,
             in_specs=(P(None, None), spec2, spec2, spec2, spec2),

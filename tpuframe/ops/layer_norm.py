@@ -31,6 +31,7 @@ from tpuframe.core.runtime import (
     current_runtime,
 )
 from tpuframe.ops.dispatch import batch_sharding_info, pad_to, resolve_interpret
+from tpuframe.core.runtime import shard_map
 
 _ROWS = 16
 _LANES = 128
@@ -233,7 +234,7 @@ def fused_layer_norm(
         return _fused(flat, s, b, eps, interpret).reshape(xs.shape)
 
     if shardable and n_shards > 1:
-        return jax.shard_map(
+        return shard_map(
             run,
             mesh=mesh,
             in_specs=(spec, P(None), P(None)),

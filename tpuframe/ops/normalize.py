@@ -22,6 +22,7 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.ops.dispatch import batch_sharding_info, resolve_interpret
+from tpuframe.core.runtime import shard_map
 
 _LANES = 128
 _TILE_ROWS = 256  # 256x128 f32 tile = 128 KiB of VMEM
@@ -139,7 +140,7 @@ def normalize_images(
 
     if shardable and n_shards > 1:
         spec = P(axes, *([None] * (images.ndim - 1)))
-        return jax.shard_map(
+        return shard_map(
             run, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
         )(images)
     return run(images)

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS
+from tpuframe.core.runtime import named_axis_size, shard_map
 
 
 def attention_reference(
@@ -136,7 +137,7 @@ def _causal_skip(pred, update, carry):
 
 def _ring_fwd_loop(q, k, v, axis_name, causal):
     """The rotating online-softmax sweep -> (out, lse)."""
-    axis_size = lax.axis_size(axis_name)
+    axis_size = named_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -194,7 +195,7 @@ def _ring_fused_bwd(axis_name, causal, res, g):
     its home device.  dQ accumulates locally.
     """
     q, k, v, out, lse = res
-    axis_size = lax.axis_size(axis_name)
+    axis_size = named_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -288,6 +289,6 @@ def ring_attention(
     """
     spec = P(tuple(batch_axes), seq_axis, head_axis, None)
     fn = functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)

@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS
 from tpuframe.ops.ring_attention import attention_reference
+from tpuframe.core.runtime import named_axis_size, shard_map
 
 
 def ulysses_attention_local(
@@ -50,7 +51,7 @@ def ulysses_attention_local(
     Args are this device's sequence shards, (B, L_local, H, D); returns
     the same shard layout.  Exact — identical to full attention.
     """
-    n = lax.axis_size(axis_name)
+    n = named_axis_size(axis_name)
     if n == 1:
         return attention_reference(q, k, v, causal=causal)
     heads = q.shape[2]
@@ -89,6 +90,6 @@ def ulysses_attention(
     """
     spec = P(tuple(batch_axes), seq_axis, None, None)
     fn = functools.partial(ulysses_attention_local, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)

@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS
+from tpuframe.core.runtime import shard_map
 
 
 def gpipe_spmd(
@@ -129,7 +130,7 @@ def gpipe_spmd(
         # (every other stage contributes zeros)
         return lax.psum(jnp.where(s == last, outputs, 0.0), axis)
 
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(param_spec, x_spec),

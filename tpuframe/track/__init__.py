@@ -13,45 +13,74 @@ hack becomes :func:`broadcast_run_id` on the control plane).
 Backend-neutral: writes the MLflow ``mlruns/`` file-store layout natively, so
 runs and artifacts are readable by any stock MLflow UI/client pointed at the
 same directory — no mlflow package required.
+
+Exports resolve lazily (PEP 562): the telemetry spine (``telemetry``,
+``watchdog`` — stdlib-only, usable while jax is wedged) must be importable
+without dragging in the profiler's train-package (and therefore jax)
+imports.  ``from tpuframe.track import X`` works exactly as before.
 """
 
-from tpuframe.track.mlflow_store import (
-    ExperimentTracker,
-    MLflowLogger,
-    Run,
-    broadcast_run_id,
-    set_experiment,
-    start_run,
-)
-from tpuframe.track.http_store import HttpExperimentTracker, HttpRun, make_tracker
-from tpuframe.track.profiler import ProfilerCallback, StepTimer, trace, trace_step_window
-from tpuframe.track.registry import (
-    HttpModelRegistry,
-    ModelRegistry,
-    ModelVersion,
-    load_model,
-)
-from tpuframe.track.tensorboard import TensorBoardLogger
-from tpuframe.track.system_metrics import SystemMetricsMonitor
+import importlib
 
-__all__ = [
-    "ExperimentTracker",
-    "MLflowLogger",
-    "Run",
-    "broadcast_run_id",
-    "set_experiment",
-    "start_run",
-    "SystemMetricsMonitor",
-    "HttpExperimentTracker",
-    "HttpRun",
-    "HttpModelRegistry",
-    "ModelRegistry",
-    "ModelVersion",
-    "load_model",
-    "make_tracker",
-    "TensorBoardLogger",
-    "ProfilerCallback",
-    "StepTimer",
-    "trace",
-    "trace_step_window",
-]
+# name -> submodule it lives in (all under tpuframe.track)
+_EXPORTS = {
+    "ExperimentTracker": "mlflow_store",
+    "MLflowLogger": "mlflow_store",
+    "Run": "mlflow_store",
+    "broadcast_run_id": "mlflow_store",
+    "set_experiment": "mlflow_store",
+    "start_run": "mlflow_store",
+    "HttpExperimentTracker": "http_store",
+    "HttpRun": "http_store",
+    "MetricsServer": "http_store",
+    "make_tracker": "http_store",
+    "ProfilerCallback": "profiler",
+    "StepTimer": "profiler",
+    "trace": "profiler",
+    "trace_step_window": "profiler",
+    "HttpModelRegistry": "registry",
+    "ModelRegistry": "registry",
+    "ModelVersion": "registry",
+    "load_model": "registry",
+    "TensorBoardLogger": "tensorboard",
+    "SystemMetricsMonitor": "system_metrics",
+    "MetricsExportCallback": "telemetry",
+    "MetricsRegistry": "telemetry",
+    "Telemetry": "telemetry",
+    "configure_telemetry": "telemetry",
+    "get_telemetry": "telemetry",
+    "publish_to_loggers": "telemetry",
+    "start_metrics_server": "telemetry",
+    "Watchdog": "watchdog",
+}
+
+# a few exports carry a different name in their home module
+_ALIASES = {"configure_telemetry": "configure"}
+
+_SUBMODULES = (
+    "http_store",
+    "mlflow_store",
+    "profiler",
+    "registry",
+    "system_metrics",
+    "telemetry",
+    "tensorboard",
+    "watchdog",
+)
+
+__all__ = sorted(_EXPORTS) + list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        mod = importlib.import_module(f"tpuframe.track.{_EXPORTS[name]}")
+        value = getattr(mod, _ALIASES.get(name, name))
+        globals()[name] = value  # cache: resolve once
+        return value
+    if name in _SUBMODULES:
+        return importlib.import_module(f"tpuframe.track.{name}")
+    raise AttributeError(f"module 'tpuframe.track' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + __all__))

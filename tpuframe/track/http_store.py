@@ -287,3 +287,67 @@ def make_tracker(tracking_uri: str):
     from tpuframe.track.mlflow_store import ExperimentTracker
 
     return ExperimentTracker(tracking_uri)
+
+
+class MetricsServer:
+    """Prometheus-style scrape endpoint over the telemetry metrics registry.
+
+    Serves ``GET /metrics`` (exposition text from
+    ``MetricsRegistry.prometheus_text``) and ``GET /healthz`` from a daemon
+    thread — the pull-based half of the telemetry spine's export story
+    (the push half is the logger bridge, ``telemetry.publish_to_loggers``).
+    ``port=0`` picks a free port; read it back from ``.port``/``.url``.
+    """
+
+    def __init__(self, registry=None, host: str = "127.0.0.1", port: int = 0):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        if registry is None:
+            from tpuframe.track.telemetry import get_telemetry
+
+            registry = get_telemetry().registry
+        self.registry = registry
+        server_self = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] == "/metrics":
+                    body = server_self.registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/healthz":
+                    body, ctype = b'{"status": "ok"}', "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tpuframe-metrics", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -92,6 +92,9 @@ class ProfilerCallback(Callback):
         self._done = False
         self.trace_dir: str | None = None
         self.artifact: str | None = None
+        #: True when the fit ended inside the capture window (the logged
+        #: trace covers fewer than ``num_steps`` steps)
+        self.partial = False
 
     def _target(self) -> str:
         if self.logdir is None and self._tmp is None:
@@ -114,33 +117,38 @@ class ProfilerCallback(Callback):
             return
         if trainer.batches_seen - self._start_batch < self.num_steps:
             return
+        self._finalize(trainer, partial=False)
+
+    def on_fit_end(self, trainer: "Trainer") -> None:
+        # fit ended mid-capture (duration reached / early stop): close the
+        # trace so the profiler isn't left running across fits, then KEEP
+        # the evidence — a partial window is still a real trace of real
+        # steps, and a fit short enough to end inside the window is
+        # exactly the fit whose trace would otherwise never exist.  Marked
+        # ``partial`` and logged like a full capture (rank-0 discipline);
+        # ``_done`` stays set so a later fit can't mix a fresh session
+        # into the same directory.
+        if self._active:
+            self._finalize(trainer, partial=True)
+
+    def _finalize(self, trainer: "Trainer", *, partial: bool) -> None:
         import jax
 
         jax.block_until_ready(trainer.state)
         jax.profiler.stop_trace()
         self._active = False
         self._done = True
-        self.trace_dir = self._target()
+        self.partial = partial
         if trainer.is_main:
             self._log_artifact(trainer)
         if self._tmp is not None:
+            # the temp capture dir is deleted below: publish the zipped
+            # artifact (``self.artifact``) instead of a dangling path
             shutil.rmtree(self._tmp, ignore_errors=True)
             self._tmp = None
-
-    def on_fit_end(self, trainer: "Trainer") -> None:
-        # fit ended mid-capture (duration reached / early stop): close the
-        # trace so the profiler isn't left running across fits.  The
-        # partial capture is discarded as done — a later fit must not mix
-        # a fresh session into the same directory.
-        if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            if self._tmp is not None:
-                shutil.rmtree(self._tmp, ignore_errors=True)
-                self._tmp = None
+            self.trace_dir = None
+        else:
+            self.trace_dir = self.logdir
 
     def _log_artifact(self, trainer: "Trainer") -> None:
         src = self._target()
@@ -164,14 +172,26 @@ class StepTimer(Callback):
     """Lightweight per-step wall-clock sampler (host side).
 
     Records the host time of each dispatched step; ``summary()`` gives
-    mean/p50/p95 step wall time over the sampled window.  Complements the
-    Trainer's built-in data-wait/dispatch/block breakdown when you want
-    per-step distributions rather than epoch totals.
+    mean/p50/p95/p99 step wall time over the sampled window.  The window
+    is a **ring** of the most recent ``max_samples`` steps (the old capped
+    list stopped sampling after the first ``max_samples`` and reported a
+    10-hour run's first minutes forever), and every sample is also folded
+    into the process telemetry registry (``callback/step_time_s``) so the
+    spine's exporters — logger bridge, Prometheus page, JSONL snapshot —
+    see the same distribution.
+
+    Largely superseded by the Trainer's own ``train/step`` spans (the
+    ``span/train/step`` histogram is recorded unconditionally); kept for
+    explicit-callback workflows and any duck-typed loop that drives
+    callbacks without the Trainer.
     """
 
     def __init__(self, max_samples: int = 4096):
+        from collections import deque
+
         self.max_samples = max_samples
-        self.samples: list[float] = []
+        self.samples: "deque[float]" = deque(maxlen=max_samples)
+        self.steps_seen = 0
         self._t0: float | None = None
 
     def on_step_start(self, trainer: "Trainer") -> None:
@@ -180,9 +200,15 @@ class StepTimer(Callback):
     def on_step_end(self, trainer: "Trainer") -> None:
         if self._t0 is None:
             return
-        if len(self.samples) < self.max_samples:
-            self.samples.append(time.perf_counter() - self._t0)
+        dt = time.perf_counter() - self._t0
+        self.samples.append(dt)
+        self.steps_seen += 1
         self._t0 = None
+        from tpuframe.track.telemetry import get_telemetry
+
+        get_telemetry().registry.histogram(
+            "callback/step_time_s", max_samples=self.max_samples
+        ).observe(dt)
 
     def summary(self) -> dict[str, float]:
         if not self.samples:
@@ -193,5 +219,7 @@ class StepTimer(Callback):
             "step_time_mean_s": sum(s) / n,
             "step_time_p50_s": s[n // 2],
             "step_time_p95_s": s[min(n - 1, int(n * 0.95))],
+            "step_time_p99_s": s[min(n - 1, int(n * 0.99))],
             "steps_sampled": float(n),
+            "steps_seen": float(self.steps_seen),
         }

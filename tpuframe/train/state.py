@@ -22,9 +22,13 @@ from tpuframe.parallel.sharding import ParallelPlan
 
 def _any_host_resident(tree: Any) -> bool:
     """True if any leaf's (traced or concrete) aval sits in host memory."""
+    try:
+        host_space = jax.memory.Space.Host
+    except AttributeError:  # older jax: no memory-space API => never offloaded
+        return False
     for leaf in jax.tree.leaves(tree):
         aval = getattr(leaf, "aval", None)
-        if getattr(aval, "memory_space", None) == jax.memory.Space.Host:
+        if getattr(aval, "memory_space", None) == host_space:
             return True
     return False
 

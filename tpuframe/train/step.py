@@ -23,6 +23,7 @@ import optax
 from tpuframe.parallel.precision import Policy, full_precision
 from tpuframe.parallel.sharding import ParallelPlan
 from tpuframe.train.state import TrainState
+from tpuframe.core.runtime import shard_map
 
 #: loss_fn(logits, labels) -> per-example losses, pluggable.
 LossFn = Callable[[jax.Array, jax.Array], jax.Array]
@@ -290,7 +291,7 @@ def _make_compressed_train_step(
         return new_state, metrics
 
     batch_spec = P(data_axes)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         shard_step,
         mesh=mesh,
         in_specs=(P(), batch_spec),  # params/state replicated, batch split

@@ -32,6 +32,7 @@ import optax
 
 from tpuframe.core import runtime as rt
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
+from tpuframe.track.telemetry import get_telemetry
 from tpuframe.parallel.precision import Policy, align_model_dtype, get_policy
 from tpuframe.parallel.sharding import ParallelPlan
 from tpuframe.train.algorithms import Algorithm, apply_algorithms, resolve_algorithms
@@ -495,7 +496,8 @@ class Trainer:
         self._emit("on_fit_start")
         try:
             while not self._done() and self._stop_reason is None:
-                epoch_metrics = self._run_epoch()
+                with get_telemetry().span("train/epoch", epoch=self.epoch):
+                    epoch_metrics = self._run_epoch()
                 eval_metrics: dict[str, float] = {}
                 if (
                     self.eval_dataloader is not None
@@ -586,32 +588,42 @@ class Trainer:
         acc = None
         window = None  # device-side metric pytree, materialized per interval
         t0 = time.perf_counter()
-        # DeepSpeed-style wall-clock breakdown
-        # (`deepspeed_config.py:47-48`): where host time goes per epoch.
+        # DeepSpeed-style wall-clock breakdown (`deepspeed_config.py:47-48`):
+        # where host time goes per epoch — now measured by telemetry spans
+        # at the SAME points the old perf_counter pairs sat, so the epoch
+        # summary keys keep their values while per-step distributions
+        # (span/train/* histograms) and the watchdog's live position come
+        # free.  Inner per-batch spans use emit=False: one JSONL event per
+        # *step* (train/step), not three.
+        tele = get_telemetry()
         data_wait = dispatch = host_block = 0.0
+        _epoch_end = object()
 
         def drain(window):
             """Materialize the device-side window (the only host sync)."""
             nonlocal host_block
-            tb = time.perf_counter()
-            out = {k: float(v) for k, v in window.items()}
-            host_block += time.perf_counter() - tb
+            with tele.span("train/host_block", emit=False) as sp:
+                out = {k: float(v) for k, v in window.items()}
+            host_block += sp.elapsed
             return out
 
         batches = iter(self._device_batches(self.train_dataloader, train=True))
         while True:
-            td = time.perf_counter()
-            try:
-                batch = next(batches)
-            except StopIteration:
-                break
-            data_wait += time.perf_counter() - td
+            with tele.span("train/data_wait", emit=False) as sp:
+                batch = next(batches, _epoch_end)
+            if batch is _epoch_end:
+                break  # the exhausted final pull never counted toward data_wait
+            data_wait += sp.elapsed
             if self._done() or self._stop_reason is not None:
                 break
             self._emit("on_step_start")
-            ts = time.perf_counter()
-            self.state, metrics = self._train_step(self.state, batch)
-            dispatch += time.perf_counter() - ts
+            # the guard turns a wedged dispatch (first-step compile, stuck
+            # collective) into an attributed watchdog report instead of a
+            # silent hang; unmonitored unless a watchdog is configured
+            with tele.span("train/step", batch=self.batches_seen) as sp, \
+                    tele.guard("train/step"):
+                self.state, metrics = self._train_step(self.state, batch)
+            dispatch += sp.elapsed
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
             if (
@@ -700,9 +712,10 @@ class Trainer:
         state = self._serving_state()
         self.eval_dataloader.set_epoch(0)
         acc = None
-        for batch in self._device_batches(self.eval_dataloader, train=False):
-            metrics = self._eval_step(state, batch)
-            acc = merge_metrics(acc, metrics)
+        with get_telemetry().span("train/eval", epoch=self.epoch):
+            for batch in self._device_batches(self.eval_dataloader, train=False):
+                metrics = self._eval_step(state, batch)
+                acc = merge_metrics(acc, metrics)
         return summarize_metrics(acc or {}, prefix="eval_")
 
     def _serving_state(self) -> TrainState:
