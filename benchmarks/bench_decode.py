@@ -102,11 +102,77 @@ def _measure(loader, seconds: float) -> float:
     return n / (time.perf_counter() - t0)
 
 
+def run_thread_scaling(args) -> None:
+    """Decode-thread scaling: the native batch decoder's img/s at each
+    thread count in ``--threads`` (e.g. ``1,2,4,8``), 256px JPEG sources
+    fused-decoded at the 224px covering scale.
+
+    This is the committed answer to "how many decode threads feed one
+    chip" — the native pool releases the GIL, so the curve is the real
+    multi-core ceiling (PIL's single-thread rate is printed alongside as
+    the floor).  Prints ONE JSON record.
+    """
+    import tempfile
+
+    thread_counts = [int(t) for t in str(args.threads).split(",")]
+    with tempfile.TemporaryDirectory(prefix="tpuframe_decscale_") as tmp:
+        paths = _make_working_set(args.images, tmp)
+        blobs = [open(p, "rb").read() for p in paths]
+    try:
+        from tpuframe.core.native import JpegDecoder, jpeg_native_available
+
+        native = jpeg_native_available()
+    except Exception:
+        native = False
+    if not native:
+        print(json.dumps({
+            "metric": "jpeg_decode_thread_scaling_images_per_sec",
+            "error": "native jpeg decoder unavailable (no g++/libjpeg)",
+        }))
+        raise SystemExit(1)
+
+    def rate(dec) -> float:
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < args.seconds:
+            dec.decode_batch(blobs, min_hw=(224, 224))
+            n += len(blobs)
+        return n / (time.perf_counter() - t0)
+
+    per_threads = {str(k): round(rate(JpegDecoder(n_threads=k)), 1)
+                   for k in thread_counts}
+    base = per_threads[str(thread_counts[0])]
+    best_threads, best = max(per_threads.items(), key=lambda kv: kv[1])
+    print(json.dumps({
+        "metric": "jpeg_decode_thread_scaling_images_per_sec",
+        "value": best,
+        "unit": "images/sec (native libjpeg batch decode at 224px "
+        "covering scale, 256px JPEG sources)",
+        "per_threads": per_threads,
+        "best_threads": int(best_threads),
+        "scaling_efficiency": {
+            k: round(v / (base * int(k) / thread_counts[0]), 3)
+            for k, v in per_threads.items()
+        },
+        "host_cores": os.cpu_count(),
+        "chip_ingest_img_s": CHIP_INGEST_IMG_S,
+        "threads_to_feed_chip": round(
+            CHIP_INGEST_IMG_S / max(base, 1e-9), 1
+        ),
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--images", type=int, default=200)
     ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--threads", default=None,
+                    help="comma list (e.g. 1,2,4,8): measure native "
+                    "decode-thread scaling instead of the loader modes")
     args = ap.parse_args()
+
+    if args.threads:
+        run_thread_scaling(args)
+        return
 
     from tpuframe.data import DataLoader
 

@@ -27,9 +27,20 @@ Prints ONE JSON line:
 blocked on ``next(batch)`` — attribution: ~0 with a nonzero stall means
 H2D/layout, not production rate, is the limiter.
 
+``--consumer null`` swaps the train step for an *instant* consumer and
+never imports jax: it measures the loader's **producer ceiling** — the
+max sustained img/s the shards->decode->augment->ring-assembly path can
+produce on THIS host, per worker count (``--workers`` takes a comma
+list).  That makes ``input_stall_pct`` computable on chip-less hosts:
+with the ceiling below the chip's ingest rate, the stall on a chip is
+arithmetic, not speculation (the "~7 cores feed one chip" projection,
+PERF.md).  The instant consumer releases each ring lease immediately, so
+the mode also exercises steady-state zero-allocation recycling.
+
 Usage:
-  python benchmarks/bench_e2e.py [--format tfs|mds] [--workers N]
+  python benchmarks/bench_e2e.py [--format tfs|mds] [--workers N[,N...]]
       [--worker-mode thread|process] [--steps N] [--images N]
+      [--consumer train|null] [--uint8-input]
 Defaults size themselves by backend (224px/batch-128 on an accelerator,
 tiny on CPU so the script runs anywhere, same convention as bench.py).
 """
@@ -60,10 +71,31 @@ def synth_image(rng, size: int) -> "np.ndarray":
     return np.clip(img.astype(np.int16) + ramp, 0, 255).astype(np.uint8)
 
 
+def _zstd_available() -> bool:
+    """Native C++ codec or the python module — either can serve shards."""
+    from tpuframe.data import streaming
+
+    if streaming._native_codec() is not None:
+        return True
+    try:
+        import zstandard  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
 def build_volume(path: str, fmt: str, n: int, size: int) -> None:
-    """Write (or reuse) a JPEG shard volume of ``n`` ``size``px images."""
+    """Write (or reuse) a JPEG shard volume of ``n`` ``size``px images.
+
+    Shard compression follows what the host can decode: zstd when a
+    codec exists, raw otherwise (JPEG columns are already compressed, so
+    the measured decode path barely changes) — the producer ceiling must
+    be measurable on any host, including codec-less sandboxes.
+    """
     meta_path = os.path.join(path, "bench_e2e_meta.json")
-    want = {"fmt": fmt, "n": n, "size": size}
+    zstd = _zstd_available()
+    want = {"fmt": fmt, "n": n, "size": size, "zstd": zstd}
     if os.path.exists(meta_path) and json.load(open(meta_path)) == want:
         return
     import numpy as np
@@ -75,13 +107,14 @@ def build_volume(path: str, fmt: str, n: int, size: int) -> None:
         from tpuframe.data.mds import MDSWriter
 
         with MDSWriter(path, {"image": "jpeg", "label": "int"},
-                       compression="zstd") as w:
+                       compression="zstd" if zstd else None) as w:
             for i in range(n):
                 w.write({"image": synth_image(rng, size), "label": i % 1000})
     else:
         from tpuframe.data.streaming import ShardWriter
 
-        with ShardWriter(path, columns={"image": "jpg", "label": "int"}) as w:
+        with ShardWriter(path, columns={"image": "jpg", "label": "int"},
+                         compression="zstd" if zstd else "none") as w:
             for i in range(n):
                 w.write({"image": synth_image(rng, size), "label": i % 1000})
     with open(meta_path, "w") as f:
@@ -90,13 +123,130 @@ def build_volume(path: str, fmt: str, n: int, size: int) -> None:
           f"{time.perf_counter() - t0:.1f}s at {path}", file=sys.stderr)
 
 
+def build_dataset(args, vol: str, size: int):
+    """The measured dataset: real transform + fused decode-at-scale."""
+    if args.uint8_input:
+        # host side does decode + geometric augmentation ONLY; dtype stays
+        # uint8 (normalize happens fused on device)
+        from tpuframe.data.transforms import uint8_image_transforms
+
+        transform = uint8_image_transforms(size)
+    else:
+        from tpuframe.data.transforms import default_image_transforms
+
+        transform = default_image_transforms(size)
+    # fused decode-at-scale: decode covers (size, size) straight out of
+    # the IDCT; the transform's Resize is the exact-size finisher
+    if args.format == "mds":
+        from tpuframe.data.mds import MDSDataset
+
+        return MDSDataset(vol, transform=transform, decode_min_hw=(size, size))
+    from tpuframe.data.streaming import StreamingDataset
+
+    return StreamingDataset(vol, transform=transform, decode_min_hw=(size, size))
+
+
+def run_null_consumer(args) -> None:
+    """Producer-ceiling mode: loader vs an instant consumer, no jax.
+
+    Sweeps the ``--workers`` list and prints ONE JSON record with
+    img/s per worker count — the committed answer to "can this host
+    feed a chip", measurable anywhere (VERDICT r05 weak #1/#2).
+    """
+    from tpuframe.data import DataLoader
+    from tpuframe.track.telemetry import get_telemetry
+
+    size = args.size or 224
+    batch = args.batch or 64
+    seconds = args.seconds
+    n_images = args.images or 512
+    src_size = args.source_size or -(-size * 8 // 7)
+    worker_counts = [int(w) for w in str(args.workers or "1").split(",")]
+    vol = args.volume_dir or os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        f"tpuframe_e2e_{args.format}_{src_size}to{size}px_{n_images}",
+    )
+    build_volume(vol, args.format, n_images, src_size)
+    reg = get_telemetry().registry
+    per_workers: dict[str, float] = {}
+    steady_allocs: dict[str, float] = {}
+    for workers in worker_counts:
+        ds = build_dataset(args, vol, size)
+        loader = DataLoader(
+            ds, batch_size=batch, shuffle=True, seed=0,
+            num_workers=workers, worker_mode=args.worker_mode,
+            process_index=0, process_count=1,
+            transfer_dtype="uint8" if args.uint8_input else None,
+        )
+        try:
+            # warmup epoch fraction: decode caches, worker spinup, ring fill
+            it = iter(loader)
+            for _ in range(2):
+                next(it)
+                loader.release_oldest()
+            allocs0 = reg.counter("data/ring_allocs").value
+            n = 0
+            t0 = time.perf_counter()
+            epoch = 0
+            while time.perf_counter() - t0 < seconds:
+                for images, labels in loader:
+                    n += labels.shape[0]
+                    # the instant consumer: done with the batch the moment
+                    # it lands — recycle its ring lease immediately
+                    loader.release_oldest()
+                    if time.perf_counter() - t0 >= seconds:
+                        break
+                epoch += 1
+                loader.set_epoch(epoch)
+            elapsed = time.perf_counter() - t0
+            per_workers[str(workers)] = round(n / elapsed, 1)
+            steady_allocs[str(workers)] = (
+                reg.counter("data/ring_allocs").value - allocs0
+            )
+        finally:
+            loader.close()
+    best_workers, best = max(per_workers.items(), key=lambda kv: kv[1])
+    # per-core producer rate: the 1-worker rung when swept, else best/N
+    per_core = per_workers.get("1") or best / max(int(best_workers), 1)
+    from bench_decode import CHIP_INGEST_IMG_S  # measured chip train rate
+
+    print(json.dumps({
+        "metric": "input_producer_ceiling_images_per_sec",
+        "value": best,
+        "unit": f"images/sec ({args.format} shards -> decode+augment -> "
+        f"ring assembly, {size}px, batch={batch}, "
+        f"{'uint8' if args.uint8_input else 'f32'} transfer, "
+        f"{args.worker_mode} workers, null consumer)",
+        "per_workers": per_workers,
+        "best_workers": int(best_workers),
+        "steady_state_ring_allocs": steady_allocs,
+        "format": args.format,
+        "worker_mode": args.worker_mode,
+        "uint8_input": args.uint8_input,
+        "images_in_volume": n_images,
+        "source_size": src_size,
+        "size": size,
+        "host_cores": os.cpu_count(),
+        "chip_ingest_img_s": CHIP_INGEST_IMG_S,
+        # cores one host needs to feed ONE chip at the measured train
+        # rate, from THIS host's per-core producer ceiling
+        "cores_to_feed_chip": round(CHIP_INGEST_IMG_S / max(per_core, 1e-9), 1),
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--format", choices=("tfs", "mds"), default="tfs")
-    ap.add_argument("--workers", type=int, default=None,
-                    help="DataLoader workers (default: os.cpu_count, cap 16)")
+    ap.add_argument("--workers", default=None,
+                    help="DataLoader workers (default: os.cpu_count, cap "
+                    "16); --consumer null accepts a comma list to sweep")
     ap.add_argument("--worker-mode", choices=("thread", "process"),
                     default="thread")
+    ap.add_argument("--consumer", choices=("train", "null"), default="train",
+                    help="null = instant consumer, no jax: measures the "
+                    "producer ceiling (max loader img/s) on any host")
+    ap.add_argument("--seconds", type=float, default=6.0,
+                    help="timed window per worker count (null consumer)")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--images", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
@@ -104,14 +254,20 @@ def main() -> None:
     ap.add_argument("--prefetch-depth", type=int, default=2)
     ap.add_argument("--volume-dir", default=None)
     ap.add_argument("--uint8-input", action="store_true",
-                    help="ship raw uint8 over host->HBM and normalize "
-                    "on-device (fused kernel) instead of host-side f32 — "
+                    help="assemble raw uint8 ring buffers "
+                    "(DataLoader(transfer_dtype='uint8')), ship them "
+                    "host->HBM and normalize on-device (fused kernel) — "
                     "4x less PCIe traffic and no host normalize cost")
     ap.add_argument("--source-size", type=int, default=None,
                     help="stored JPEG size (default ~8/7 of --size: "
                     "sources larger than the train size, the ImageNet "
                     "reality, exercising the fused decode-at-scale path)")
     args = ap.parse_args()
+
+    if args.consumer == "null":
+        # the whole point: measurable without a chip — and without jax
+        run_null_consumer(args)
+        return
 
     from bench import (
         BASELINE_IMG_PER_SEC,
@@ -127,7 +283,6 @@ def main() -> None:
 
     from tpuframe.core.runtime import MeshSpec
     from tpuframe.data import DataLoader, DevicePrefetcher
-    from tpuframe.data.transforms import default_image_transforms
     from tpuframe.models import ResNet50
     from tpuframe.parallel import (
         ParallelPlan,
@@ -142,8 +297,10 @@ def main() -> None:
     size = args.size or (224 if on_accel else 32)
     batch = args.batch or (128 * chips if on_accel else 8)
     steps = args.steps or (40 if on_accel else 6)
-    workers = args.workers if args.workers is not None else min(
-        os.cpu_count() or 1, 16
+    workers = (
+        int(str(args.workers).split(",")[0])
+        if args.workers is not None
+        else min(os.cpu_count() or 1, 16)
     )
     # enough images that the timed window spans >=2 epochs at most (decode
     # cache effects show up, volume build stays bounded)
@@ -199,30 +356,14 @@ def main() -> None:
     )
 
     # --- window 2: the real pipeline ------------------------------------
-    if args.uint8_input:
-        # host side does decode + geometric augmentation ONLY; dtype stays
-        # uint8 (normalize happens fused on device)
-        from tpuframe.data.transforms import Compose, RandomHorizontalFlip, Resize
-
-        transform = Compose([Resize(size), RandomHorizontalFlip()])
-    else:
-        transform = default_image_transforms(size)
-    # fused decode-at-scale: decode covers (size, size) straight out of
-    # the IDCT; the transform's Resize is the exact-size finisher
-    if args.format == "mds":
-        from tpuframe.data.mds import MDSDataset
-
-        ds = MDSDataset(vol, transform=transform,
-                        decode_min_hw=(size, size))
-    else:
-        from tpuframe.data.streaming import StreamingDataset
-
-        ds = StreamingDataset(vol, transform=transform,
-                              decode_min_hw=(size, size))
+    ds = build_dataset(args, vol, size)
     loader = DataLoader(
         ds, batch_size=batch, shuffle=True, seed=0,
         num_workers=workers, worker_mode=args.worker_mode,
         process_index=0, process_count=1,
+        # uint8 ring buffers: raw bytes cross host->HBM, normalize is
+        # fused on-device (batch_transform above)
+        transfer_dtype="uint8" if args.uint8_input else None,
     )
 
     host_dtype = np.uint8 if args.uint8_input else np.float32
@@ -242,6 +383,9 @@ def main() -> None:
     pf = iter(DevicePrefetcher(
         epochs(), depth=args.prefetch_depth,
         sharding=plan.batch_sharding(),
+        # epochs() yields one dict per loader batch: FIFO lease release
+        # after each H2D recycles the ring (steady-state zero allocs)
+        recycler=loader,
     ))
     # warmup: fills the prefetch queue, pays any worker-pool spinup
     for _ in range(2):

@@ -12,11 +12,17 @@ Covers: fused LayerNorm (fwd+grads), fused cross-entropy (fwd+grad),
 fused AdamW (vs optax), fused normalize, blockwise attention
 (fwd+grads, causal and not), ring attention oracle parity on one device.
 
-Usage: python benchmarks/check_kernels_tpu.py  (exits 1 on any failure)
+Usage: python benchmarks/check_kernels_tpu.py [--only a,b,...]
+(exits 1 on any failure).  ``--only`` runs a named subset — sections:
+layer_norm, cross_entropy, adamw, normalize, blockwise, ring.  The
+capture script's value-ordered pass runs a cheap elementwise subset
+first (layer_norm,cross_entropy,normalize) so a short live window still
+lands kernel evidence before the expensive attention sections.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -33,7 +39,25 @@ def record(check: str, diff: float, tol: float) -> None:
                       "tol": tol, "pass": ok}), flush=True)
 
 
+SECTIONS = ("layer_norm", "cross_entropy", "adamw", "normalize",
+            "blockwise", "ring")
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help=f"comma list of sections to run ({','.join(SECTIONS)})")
+    cli = ap.parse_args()
+    if cli.only:
+        chosen = set(cli.only.split(","))
+        unknown = chosen - set(SECTIONS)
+        if unknown:
+            raise SystemExit(f"unknown sections {sorted(unknown)}; "
+                             f"known: {list(SECTIONS)}")
+    else:
+        chosen = set(SECTIONS)
+    want = chosen.__contains__
+
     import bench as headline_bench
 
     headline_bench.enable_compile_cache()
@@ -51,6 +75,30 @@ def main() -> None:
     rng = np.random.default_rng(0)
 
     # --- fused LayerNorm: fwd + all three grads --------------------------
+    if want("layer_norm"):
+        _check_layer_norm(jax, jnp, np, rng)
+
+    # --- fused cross-entropy: value + logits grad ------------------------
+    if want("cross_entropy"):
+        _check_cross_entropy(jax, jnp, np, rng)
+
+    # --- fused AdamW vs optax -------------------------------------------
+    if want("adamw"):
+        _check_adamw(jax, jnp, np, rng)
+
+    # --- fused normalize -------------------------------------------------
+    if want("normalize"):
+        _check_normalize(jax, jnp, np, rng)
+
+    # --- attention: blockwise fwd/grads + ring shard_map path ------------
+    if want("blockwise") or want("ring"):
+        _check_attention(jax, jnp, np, rng,
+                         blockwise=want("blockwise"), ring=want("ring"))
+
+    raise SystemExit(0 if all(RESULTS) else 1)
+
+
+def _check_layer_norm(jax, jnp, np, rng) -> None:
     from tpuframe.ops.layer_norm import fused_layer_norm, layer_norm_reference
 
     x = jnp.asarray(rng.standard_normal((1024, 768)), jnp.float32)
@@ -70,7 +118,8 @@ def main() -> None:
     for name, a, c in zip(("dx", "dscale", "dbias"), gf, gr):
         record(f"layer_norm_{name}", float(jnp.max(jnp.abs(a - c))), 5e-4)
 
-    # --- fused cross-entropy: value + logits grad ------------------------
+
+def _check_cross_entropy(jax, jnp, np, rng) -> None:
     from tpuframe.ops.cross_entropy import (
         cross_entropy_reference,
         fused_cross_entropy,
@@ -85,7 +134,8 @@ def main() -> None:
     record("cross_entropy_value", abs(float(vf - vr)), 1e-2)
     record("cross_entropy_grad", float(jnp.max(jnp.abs(gf2 - gr2))), 1e-4)
 
-    # --- fused AdamW vs optax -------------------------------------------
+
+def _check_adamw(jax, jnp, np, rng) -> None:
     import optax
 
     from tpuframe.ops.fused_adamw import fused_adamw
@@ -105,7 +155,8 @@ def main() -> None:
         1e-5,
     )
 
-    # --- fused normalize -------------------------------------------------
+
+def _check_normalize(jax, jnp, np, rng) -> None:
     from tpuframe.ops.normalize import normalize_images, normalize_images_reference
 
     raw = jnp.asarray(rng.integers(0, 256, (64, 224, 224, 3)), jnp.uint8)
@@ -119,13 +170,15 @@ def main() -> None:
         1e-5,
     )
 
+
+def _check_attention(jax, jnp, np, rng, *, blockwise: bool, ring: bool) -> None:
     # --- blockwise attention: fwd + grads, causal and bidirectional ------
     from tpuframe.ops.blockwise_attention import blockwise_attention
     from tpuframe.ops.ring_attention import attention_reference
 
     q, k, v = (jnp.asarray(rng.standard_normal((2, 300, 4, 32)) * 0.3,
                            jnp.float32) for _ in range(3))
-    for causal in (False, True):
+    for causal in (False, True) if blockwise else ():
         tag = "causal" if causal else "bidir"
         got = jax.jit(lambda q, k, v, c=causal: blockwise_attention(
             q, k, v, causal=c, block_size=128))(q, k, v)
@@ -150,6 +203,8 @@ def main() -> None:
     # --- ring attention: the shard_map + custom-vjp path on hardware -----
     # One chip means a 1-device seq axis (single hop, no rotation) — still
     # the real shard_map lowering and the hand-written backward on-device.
+    if not ring:
+        return
     from jax.sharding import Mesh
 
     from tpuframe.ops.ring_attention import ring_attention
@@ -171,8 +226,6 @@ def main() -> None:
         max(float(jnp.max(jnp.abs(a - c))) for a, c in zip(gr3, go3)),
         2e-2,
     )
-
-    raise SystemExit(0 if all(RESULTS) else 1)
 
 
 if __name__ == "__main__":

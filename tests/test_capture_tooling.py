@@ -121,6 +121,98 @@ def test_scripts_parse(script):
                    check=True, timeout=30)
 
 
+class TestValueOrderAndTimebox:
+    """VERDICT r05 #2: live windows die without warning, so the ladder
+    must run highest-value-first and respect a MAX_WINDOW budget —
+    whatever was promoted before the kill is the harvest."""
+
+    TOP4 = ["bench_live.json", "check_kernels_subset.json",
+            "check_offload_tpu.json", "bench_e2e_tpu.json"]
+
+    def test_ladder_runs_top_value_rungs_first(self):
+        """The committed rung order IS the value order: headline, kernel
+        subset, offload, e2e-stall before everything else."""
+        order = []
+        for ln in open(SCRIPT):
+            ln = ln.strip()
+            if ln.startswith("run ") and not ln.startswith("run()"):
+                order.append(ln.split()[1])
+        assert order[:4] == self.TOP4, order
+        # and the producer-ceiling + decode-scaling rungs are wired in
+        assert "bench_e2e_ceiling.json" in order
+        assert "bench_decode_scaling.json" in order
+
+    def _ladder(self, tmp_path, max_window, rungs):
+        harness = f"""
+set -u
+cd {tmp_path}
+mkdir -p benchmarks/results
+ONCHIP=0
+MAX_WINDOW={max_window}
+verify_onchip() {{ return 1; }}
+{extract_run_fn()}
+{rungs}
+"""
+        return subprocess.run(["bash", "-c", harness], capture_output=True,
+                              text=True, timeout=120)
+
+    def test_budget_spent_skips_low_value_tail(self, tmp_path):
+        proc = self._ladder(tmp_path, 3, "\n".join([
+            'run first.json 30 sh -c \'sleep 1.2; echo "{\\"v\\": 1}"\'',
+            'run second.json 30 sh -c \'echo "{\\"v\\": 2}"\'',
+            'run third.json 30 sh -c \'echo "{\\"v\\": 3}"\'',
+        ]))
+        assert proc.returncode == 0, proc.stderr
+        # first fit within budget; the low-value tail is skipped loudly
+        assert read(tmp_path, "first.json") is not None
+        assert read(tmp_path, "second.json") is None
+        assert read(tmp_path, "third.json") is None
+        assert proc.stdout.count("SKIPPED") == 2, proc.stdout
+
+    def test_rung_timeout_clamped_to_remaining_budget(self, tmp_path):
+        proc = self._ladder(tmp_path, 3, "\n".join([
+            # 30s nominal timeout but only ~3s of budget: the rung is
+            # clamped, and since the command outlives the clamp it fails
+            # in ~3s WITHOUT eating the nominal 30
+            'run slow.json 30 sh -c \'sleep 20; echo never\'',
+        ]))
+        assert proc.returncode == 0, proc.stderr
+        assert "clamping" in proc.stdout, proc.stdout
+        assert read(tmp_path, "slow.json") is None  # timed out, staged only
+
+    def test_simulated_window_kill_promotes_top_rungs(self, tmp_path):
+        """The 10-minute-window simulation, scaled 100x: a ladder of six
+        rungs killed mid-pass still has every previously-finished rung
+        promoted (incremental promotion), nothing staged."""
+        rungs = "\n".join(
+            f'run r{i}.json 30 sh -c \'sleep 0.55; echo "{{\\"rung\\": {i}}}"\''
+            for i in range(6)
+        )
+        harness = f"""
+set -u
+cd {tmp_path}
+mkdir -p benchmarks/results
+ONCHIP=0
+verify_onchip() {{ return 1; }}
+{extract_run_fn()}
+{rungs}
+"""
+        proc = subprocess.run(
+            ["timeout", "2.4", "bash", "-c", harness],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 124  # the window died mid-ladder
+        names = os.listdir(tmp_path / "benchmarks" / "results")
+        promoted = [n for n in names if n.endswith(".json")]
+        # ~4 rungs fit in 2.4s of 0.55s rungs; every FINISHED rung was
+        # promoted before the kill — only the in-flight one may have left
+        # a staging file behind
+        assert len(promoted) >= 3, names
+        assert sum(n.endswith(".json.new") for n in names) <= 1, names
+        for n in promoted:
+            assert read(tmp_path, n).startswith('{"rung":')
+
+
 class TestCaptureRunDefenseInDepth:
     def test_unstamped_tpu_content_survives_cpu_pass(self, tmp_path):
         """On-chip evidence whose .onchip sidecar is missing (selective

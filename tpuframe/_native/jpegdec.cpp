@@ -18,8 +18,11 @@
 #include <cstddef>  // jpeglib.h uses size_t/FILE without including them
 #include <cstdio>
 
-#include <jerror.h>
+// jpeglib.h first: it pulls jconfig.h, whose D_ARITH_CODING_SUPPORTED
+// gates whether jerror.h's enum even contains JWRN_ARITH_BAD_CODE
 #include <jpeglib.h>
+
+#include <jerror.h>
 
 #include <atomic>
 #include <csetjmp>
@@ -42,15 +45,26 @@ void err_exit(j_common_ptr cinfo) {
 }
 
 void silent_emit(j_common_ptr cinfo, int msg_level) {
-  // Keep quiet but keep COUNTING — and count only TRUNCATION-class
-  // warnings (premature EOF / hit marker / resync) as failures.  Benign
-  // warnings (extraneous bytes, spec quirks common in scraped data) must
-  // not fail the item: that would silently decode twice (full native
-  // scan, then the PIL fallback), inverting the fast path's advantage.
+  // Keep quiet but keep COUNTING — and count only CORRUPTION-class
+  // warnings as failures: truncation (premature EOF / hit marker /
+  // resync) and corrupt entropy-coded data (bad Huffman/arithmetic
+  // codes — libjpeg "recovers" from those by emitting garbage pixels
+  // with rc=0, so they must fail the item to reach the PIL fallback,
+  // ADVICE r05 #2).  Benign warnings (extraneous bytes, spec quirks
+  // common in scraped data) must not fail the item: that would silently
+  // decode twice (full native scan, then the PIL fallback), inverting
+  // the fast path's advantage.
   if (msg_level < 0) {
     int code = cinfo->err->msg_code;
     if (code == JWRN_JPEG_EOF || code == JWRN_HIT_MARKER ||
-        code == JWRN_MUST_RESYNC)
+        code == JWRN_MUST_RESYNC || code == JWRN_HUFF_BAD_CODE
+#ifdef D_ARITH_CODING_SUPPORTED
+        // the enum member only exists when jconfig.h enables arithmetic
+        // decoding — an unguarded use would break the build (and thus
+        // the whole native fast path) on arith-less libjpeg builds
+        || code == JWRN_ARITH_BAD_CODE
+#endif
+    )
       cinfo->err->num_warnings++;
   }
 }

@@ -37,6 +37,45 @@ _BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
 _LOCK = threading.Lock()
 _LIBS: dict[str, ctypes.CDLL | None] = {}
 
+#: hash-keyed .so builds kept per library (newest first).  More than one:
+#: two long-lived processes on different source versions of a shared
+#: checkout would otherwise delete each other's current build on every
+#: compile and ping-pong full g++ rebuilds forever (ADVICE r05 #4).
+_KEEP_BUILDS = max(1, int(os.environ.get("TPUFRAME_NATIVE_KEEP_BUILDS", "3")))
+
+
+def _prune_stale_builds(build_dir: str, name: str, current_so: str,
+                        keep: int = _KEEP_BUILDS) -> list[str]:
+    """Delete this library's hash-keyed builds beyond the ``keep`` newest
+    (the just-written ``current_so`` always survives).  Returns the
+    basenames removed.  Safe on Linux even if another process still has a
+    victim dlopened; a not-yet-dlopened process rebuilds from its own
+    source and retries."""
+    prefix, removed = f"lib{name}.", []
+    try:
+        entries = os.listdir(build_dir)
+    except OSError:
+        return removed
+    candidates = []
+    for base in entries:
+        if not (base.startswith(prefix) and base.endswith(".so")):
+            continue
+        path = os.path.join(build_dir, base)
+        if base == os.path.basename(current_so):
+            continue
+        try:
+            candidates.append((os.path.getmtime(path), base))
+        except OSError:
+            continue  # concurrently pruned by another process
+    candidates.sort(reverse=True)
+    for _, base in candidates[max(0, keep - 1):]:  # current counts toward keep
+        try:
+            os.remove(os.path.join(build_dir, base))
+            removed.append(base)
+        except OSError:
+            pass
+    return removed
+
 
 def _build_and_load(name: str, source: str, extra_libs: Sequence[str]) -> ctypes.CDLL | None:
     """Compile ``source`` (if stale) and dlopen it; None if unavailable."""
@@ -61,17 +100,7 @@ def _build_and_load(name: str, source: str, extra_libs: Sequence[str]) -> ctypes
                     cmd, check=True, capture_output=True, timeout=120
                 )
                 os.replace(tmp, so_path)  # atomic vs. concurrent builders
-                # stale hash-keyed builds are dead weight; deleting is
-                # safe on Linux even if an older process still has one
-                # dlopened (a not-yet-dlopened process retries below)
-                prefix = f"lib{name}."
-                for old in os.listdir(_BUILD_DIR):
-                    if (old.startswith(prefix) and old.endswith(".so")
-                            and old != os.path.basename(so_path)):
-                        try:
-                            os.remove(os.path.join(_BUILD_DIR, old))
-                        except OSError:
-                            pass
+                _prune_stale_builds(_BUILD_DIR, name, so_path)
 
             if not os.path.exists(so_path):
                 build()
@@ -135,6 +164,22 @@ def jpeg_native_available() -> bool:
     return _jpeg_lib() is not None
 
 
+#: decompression-bomb fallback when PIL isn't importable: PIL's own
+#: default MAX_IMAGE_PIXELS (0.25 GiB of 32-bit pixels)
+_DEFAULT_MAX_PIXELS = 178956970
+
+
+def _pil_max_pixels() -> int:
+    try:
+        from PIL import Image
+
+        # a user's Image.MAX_IMAGE_PIXELS = None disables PIL's guard;
+        # mirror that as "no budget"
+        return Image.MAX_IMAGE_PIXELS or (1 << 62)
+    except ImportError:
+        return _DEFAULT_MAX_PIXELS
+
+
 class JpegDecoder:
     """Batch JPEG decode backed by libjpeg(-turbo) on a C++ thread pool.
 
@@ -142,13 +187,23 @@ class JpegDecoder:
     (matching PIL's ``np.asarray(Image.open(...))`` shapes so the two
     decode paths are drop-in interchangeable).  Exotic color spaces
     (CMYK/YCCK) fail the item; callers fall back to PIL for those.
+
+    ``max_pixels`` (default: PIL's ``Image.MAX_IMAGE_PIXELS``) bounds
+    header-declared output size *before* any allocation: a
+    few-hundred-byte JPEG claiming 65500x65500 would otherwise force a
+    ~12.8 GB allocation per item (the decompression-bomb guard PIL
+    enforces and the native fast path must not bypass, ADVICE r05 #3).
+    Oversized items raise ValueError — callers fall back to PIL, whose
+    own bomb limit then decides.
     """
 
-    def __init__(self, n_threads: int | None = None):
+    def __init__(self, n_threads: int | None = None,
+                 max_pixels: int | None = None):
         self._lib = _jpeg_lib()
         if self._lib is None:
             raise RuntimeError("native jpeg decoder unavailable (no g++/libjpeg)")
         self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+        self.max_pixels = _pil_max_pixels() if max_pixels is None else int(max_pixels)
 
     def decode_batch(self, blobs: Sequence[bytes],
                      min_hw: tuple | None = None) -> list:
@@ -174,6 +229,23 @@ class JpegDecoder:
         rc = self._lib.tfj_dims(src_p, sizes, n, min_h, min_w, dims)
         if rc != 0:
             raise ValueError(f"invalid JPEG header at item {rc - 1}")
+        # Decompression-bomb guard BEFORE any allocation: budget the
+        # header-DECLARED dims (PIL's Image.open semantics), not the
+        # scaled output — fused decode-at-scale shrinks the buffer up to
+        # 64x but the entropy-decode cost still tracks the declared size.
+        if self.max_pixels:
+            decl = dims
+            if min_h or min_w:  # dims above are at the covering M/8 scale
+                decl = (ctypes.c_int32 * (3 * n))()
+                self._lib.tfj_dims(src_p, sizes, n, 0, 0, decl)
+            for i in range(n):
+                h, w = int(decl[3 * i]), int(decl[3 * i + 1])
+                if h * w > self.max_pixels:
+                    raise ValueError(
+                        f"image {i}: header declares {h}x{w} = {h * w} "
+                        f"pixels, over the {self.max_pixels}-pixel budget "
+                        "(decompression-bomb guard)"
+                    )
         outs = []
         for i in range(n):
             h, w, c = dims[3 * i], dims[3 * i + 1], dims[3 * i + 2]

@@ -15,7 +15,7 @@ from tpuframe.data.datasets import (
     hfds_download,
     make_image_dataset,
 )
-from tpuframe.data.loader import DataLoader, DevicePrefetcher
+from tpuframe.data.loader import BatchBufferPool, DataLoader, DevicePrefetcher
 from tpuframe.data.mds import MDSDataset, MDSWriter, mds_to_tfs
 from tpuframe.data.streaming import ShardWriter, StreamingDataset, clean_stale_cache
 from tpuframe.data.transforms import (
@@ -28,6 +28,7 @@ from tpuframe.data.transforms import (
     Resize,
     ToFloat,
     default_image_transforms,
+    uint8_image_transforms,
 )
 
 __all__ = [
@@ -37,6 +38,7 @@ __all__ = [
     "hf_get_num_classes",
     "hfds_download",
     "make_image_dataset",
+    "BatchBufferPool",
     "DataLoader",
     "DevicePrefetcher",
     "MDSDataset",
@@ -54,4 +56,5 @@ __all__ = [
     "Normalize",
     "ToFloat",
     "default_image_transforms",
+    "uint8_image_transforms",
 ]

@@ -20,6 +20,7 @@ mask (eval) so XLA never recompiles.
 
 from __future__ import annotations
 
+import collections
 import multiprocessing
 import queue
 import threading
@@ -31,6 +32,137 @@ import numpy as np
 
 from tpuframe.core import runtime as rt
 from tpuframe.track.telemetry import get_telemetry
+
+#: XLA's CPU client zero-copies suitably-aligned host numpy buffers into
+#: jax Arrays (measured on this jax: ``device_put`` of a 64-byte-aligned
+#: f32 array aliases — mutating the numpy buffer afterwards mutates the
+#: "device" value; small shard slices alias at a finer 16-byte grain).
+#: Ring buffers are recycled after the device copy, so they must NEVER
+#: be zero-copy donated.  Three layers keep that true: large buffers are
+#: allocated off the 64-byte grain (here), tiny leaves get a private
+#: copy before device_put (``DevicePrefetcher._SMALL_LEAF_BYTES``), and
+#: ``BatchBufferPool.release`` re-verifies with ``np.shares_memory``
+#: before any buffer re-enters the pool — the authoritative guard.
+_XLA_ALIGN = 64
+
+
+def _alloc_unaliasable(shape: tuple, dtype) -> np.ndarray:
+    """A numpy array whose data pointer is deliberately NOT 64-byte
+    aligned, so ``jax.device_put`` must copy instead of aliasing it."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    # offset is a multiple of 8 (any dtype stays element-aligned) chosen
+    # so the resulting pointer misses the 64-byte grain
+    base = np.empty(nbytes + 2 * _XLA_ALIGN, np.uint8)
+    addr = base.ctypes.data
+    off = 8 if (addr + 8) % _XLA_ALIGN else 16
+    return base[off : off + nbytes].view(dtype).reshape(shape)
+
+
+def _aliases_host(device_arrays, host_bufs: "Sequence[np.ndarray]") -> bool:
+    """True if any addressable shard of the device pytree shares memory
+    with any of the host buffers (possible only on the CPU backend's
+    zero-copy path; checked before a buffer is recycled)."""
+    for leaf in jax.tree.leaves(device_arrays):
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            devices = leaf.devices()
+        except Exception:
+            continue
+        if any(d.platform != "cpu" for d in devices):
+            continue  # real H2D transfer: device memory never aliases host
+        for shard in leaf.addressable_shards:
+            view = np.asarray(shard.data)  # zero-copy view on CPU
+            if any(np.shares_memory(view, b) for b in host_bufs):
+                return True
+    return False
+
+
+class _BatchLease:
+    """One pooled batch's buffers, outstanding until recycled."""
+
+    __slots__ = ("images", "labels", "valid")
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 valid: np.ndarray | None):
+        self.images = images
+        self.labels = labels
+        self.valid = valid
+
+    def buffers(self) -> list:
+        out = [self.images, self.labels]
+        if self.valid is not None:
+            out.append(self.valid)
+        return out
+
+
+class BatchBufferPool:
+    """Small pool of preallocated, reusable batch buffers (the ring).
+
+    Replaces per-batch ``np.stack`` allocations in :class:`DataLoader`
+    assembly: workers write decoded samples directly into a leased
+    buffer's rows; the lease returns to the pool once the consumer is
+    done with it (in the standard pipeline: after the
+    :class:`DevicePrefetcher`'s host->device copy of that batch
+    completes).  Consumers that never release simply cause fresh
+    allocations — exactly the old behavior, made visible through the
+    ``data/ring_allocs`` counter (steady-state zero when recycling
+    works).
+
+    Buffers are allocated off XLA's 64-byte zero-copy grain (see
+    ``_alloc_unaliasable``) so a recycled buffer can never alias live
+    device data, and ``release`` re-verifies that against the device
+    arrays as defense in depth.
+    """
+
+    def __init__(self, size: int = 4):
+        self.size = max(1, int(size))
+        self._spec: tuple | None = None
+        self._free: collections.deque[_BatchLease] = collections.deque()
+        self._lock = threading.Lock()
+        reg = get_telemetry().registry
+        self._allocs = reg.counter("data/ring_allocs")
+        self._recycled = reg.counter("data/ring_recycled")
+
+    def acquire(self, batch: int, item_shape: tuple, dtype,
+                with_valid: bool) -> _BatchLease:
+        """A free pooled lease, or a freshly allocated one (counted)."""
+        spec = (int(batch), tuple(item_shape), np.dtype(dtype), bool(with_valid))
+        with self._lock:
+            if spec != self._spec:  # shape/dtype change: old buffers useless
+                self._spec = spec
+                self._free.clear()
+            if self._free:
+                return self._free.popleft()
+        self._allocs.inc()
+        return _BatchLease(
+            _alloc_unaliasable((batch,) + tuple(item_shape), dtype),
+            _alloc_unaliasable((batch,), np.int32),
+            _alloc_unaliasable((batch,), np.bool_) if with_valid else None,
+        )
+
+    def release(self, lease: _BatchLease, device_arrays=None) -> bool:
+        """Return ``lease`` to the pool.  ``device_arrays`` (the jax
+        pytree built from it) gates recycling: an aliasing buffer — the
+        CPU backend's zero-copy path, never expected given the
+        misaligned allocation — is dropped, not reused."""
+        if device_arrays is not None and _aliases_host(
+            device_arrays, lease.buffers()
+        ):
+            return False
+        with self._lock:
+            lease_spec = (
+                lease.labels.shape[0],
+                lease.images.shape[1:],
+                lease.images.dtype,
+                lease.valid is not None,
+            )
+            if lease_spec == self._spec and len(self._free) < self.size:
+                self._free.append(lease)
+                self._recycled.inc()
+                return True
+        return False
 
 # Process-pool workers inherit the dataset via fork (copy-on-write — no
 # per-item pickling of the dataset, only of the returned samples).  A
@@ -85,6 +217,22 @@ class DataLoader:
         (ours only touch the dataset).  ``"forkserver"``/``"spawn"``
         avoid that entirely but pickle the dataset once at pool creation
         (StreamingDataset pickles fine; locks/caches are re-created).
+      transfer_dtype: dtype of the assembled batch buffers — what
+        actually crosses host->HBM.  ``None`` (default) follows the
+        first sample's dtype.  ``"uint8"`` is the 4x-less-PCIe path:
+        pair with a geometric-only transform
+        (:func:`tpuframe.data.transforms.uint8_image_transforms`) and
+        on-device normalization (``Trainer(normalize=...)`` or the
+        fused ``tpuframe.ops.normalize_images``).  Samples are cast on
+        write with ``casting="same_kind"`` — a float sample under
+        ``transfer_dtype="uint8"`` raises instead of silently
+        truncating.
+      ring_buffers: size of the preallocated batch-buffer pool (the
+        assembly ring).  Batches are views of pooled buffers, recycled
+        after the :class:`DevicePrefetcher` finishes the device copy;
+        steady-state assembly allocations are zero.  Consumers that
+        hold many batches at once simply trigger fresh allocations
+        (``data/ring_allocs`` counter) — never corruption.
     """
 
     def __init__(
@@ -100,6 +248,8 @@ class DataLoader:
         mp_context: str = "fork",
         process_index: int | None = None,
         process_count: int | None = None,
+        transfer_dtype: str | None = None,
+        ring_buffers: int = 4,
     ):
         if worker_mode not in ("thread", "process"):
             raise ValueError(
@@ -114,6 +264,25 @@ class DataLoader:
         self.drop_last = drop_last
         self.num_workers = num_workers
         self.worker_mode = worker_mode
+        self.transfer_dtype = (
+            np.dtype(transfer_dtype) if transfer_dtype is not None else None
+        )
+        self._pool = BatchBufferPool(ring_buffers)
+        # FIFO of yielded-but-unreleased leases: release_oldest() recycles
+        # in yield order (the DevicePrefetcher transfers batches in that
+        # same order).  Bounded — a consumer that never releases must not
+        # pin every buffer ever yielded — but drops are COUNTED, not
+        # silent: each dropped lease swallows one future release, so the
+        # FIFO pairing of releases to leases can never shift onto a
+        # batch the consumer still holds.
+        self._outstanding: collections.deque = collections.deque()
+        self._outstanding_cap = max(8, 4 * ring_buffers)
+        self._dropped_leases = 0
+        self._lease_lock = threading.Lock()
+        # bumped per __iter__: release_oldest never recycles a lease from
+        # an abandoned earlier iteration (whose consumer may still hold
+        # the views) — it forgets them instead
+        self._iter_gen = 0
         self._proc_pool = None
         # (epoch, batches_yielded) as ONE tuple: the position is read from
         # the DevicePrefetcher's background thread while set_epoch /
@@ -230,6 +399,37 @@ class DataLoader:
         self._resume_offset = offset
         self._pos = (int(state["epoch"]), offset)
 
+    def release_oldest(self, device_arrays=None) -> bool:
+        """Recycle the oldest outstanding batch's ring buffers (FIFO).
+
+        Call once per consumed batch, after nothing reads its numpy
+        views anymore — the :class:`DevicePrefetcher` calls this right
+        after the host->device copy of that batch completes (batches are
+        transferred in yield order, so FIFO release matches).
+        ``device_arrays`` (the jax pytree built from the batch) lets the
+        pool verify the buffers don't alias live device memory before
+        reuse.  Returns True when a buffer actually re-entered the pool.
+        """
+        with self._lease_lock:
+            if self._dropped_leases:
+                # the lease this release pairs with fell off the bounded
+                # FIFO: swallow the release so later ones stay aligned
+                # with their own leases
+                self._dropped_leases -= 1
+                return False
+            try:
+                gen, lease = self._outstanding.popleft()
+            except IndexError:
+                return False
+        if gen != self._iter_gen:
+            # stale lease from an abandoned iteration: its views may
+            # still be held by the old consumer — and this release was
+            # for that iteration's batch anyway.  Forget both; walking
+            # on into current-generation leases here could recycle a
+            # buffer whose own H2D hasn't happened yet.
+            return False
+        return self._pool.release(lease, device_arrays)
+
     def _per_process_count(self) -> int:
         n = len(self.dataset)
         if not self.drop_last and n % self.process_count:
@@ -294,6 +494,14 @@ class DataLoader:
             pass
 
     def __iter__(self) -> Iterator[tuple]:
+        # generation bump at ITERATOR CREATION (not first next()): any
+        # outstanding lease of a previous iteration is stale from this
+        # moment, so a late release from its abandoned consumer can never
+        # recycle buffers into this iteration
+        self._iter_gen += 1
+        return self._iter_batches(self._iter_gen)
+
+    def _iter_batches(self, gen: int) -> Iterator[tuple]:
         # the generator captures ITS epoch once and pairs it with every
         # position write — a concurrent set_epoch on another thread can
         # replace _pos wholesale but never produce a mixed pair
@@ -325,31 +533,55 @@ class DataLoader:
         start = min(self._resume_offset, len(self))
         self._resume_offset = 0
         self._pos = (epoch, start)
+        tele = get_telemetry()
+
+        def assemble(items, gen_rows) -> tuple:
+            """Write fetched samples into a leased ring buffer — the
+            zero-allocation replacement for per-batch ``np.stack``."""
+            n = len(items)
+            first = np.asarray(items[0][0])
+            dtype = self.transfer_dtype or first.dtype
+            lease = self._pool.acquire(
+                self.local_batch_size, first.shape, dtype,
+                with_valid=not self.drop_last,
+            )
+            for i, (im, lb) in enumerate(items):
+                # same_kind: a float sample under transfer_dtype="uint8"
+                # raises instead of silently truncating to garbage
+                np.copyto(lease.images[i], im, casting="same_kind")
+                lease.labels[i] = lb
+            for i in range(n, self.local_batch_size):  # ragged-tail pad
+                np.copyto(lease.images[i], items[-1][0], casting="same_kind")
+                lease.labels[i] = items[-1][1]
+            if lease.valid is None:
+                out = (lease.images, lease.labels)
+            else:
+                lease.valid[:n] = gen_rows
+                lease.valid[n:] = False
+                out = (lease.images, lease.labels, lease.valid)
+            with self._lease_lock:
+                self._outstanding.append((gen, lease))
+                if len(self._outstanding) > self._outstanding_cap:
+                    self._outstanding.popleft()
+                    self._dropped_leases += 1
+            return out
+
         try:
             for b in range(start, nb_full):
                 sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)
-                items = fetch(indices[sl])
-                images = np.stack([im for im, _ in items])
-                labels = np.asarray([lb for _, lb in items], np.int32)
+                with tele.span("data/assemble", batch=b):
+                    out = assemble(fetch(indices[sl]), genuine[sl])
                 # count BEFORE the yield: a generator suspends AT the
                 # yield, so a post-yield update would lag one batch behind
                 # what the caller has already consumed
                 self._pos = (epoch, b + 1)
-                if self.drop_last:
-                    yield images, labels
-                else:
-                    yield images, labels, genuine[sl].copy()
+                yield out
             if tail and not self.drop_last and start <= nb_full:
                 sl = slice(nb_full * self.local_batch_size, None)
-                items = fetch(indices[sl])
-                pad = self.local_batch_size - len(items)
-                images = np.stack([im for im, _ in items] + [items[-1][0]] * pad)
-                labels = np.asarray(
-                    [lb for _, lb in items] + [items[-1][1]] * pad, np.int32
-                )
-                valid = np.concatenate([genuine[sl], np.zeros(pad, bool)])
+                with tele.span("data/assemble", batch=nb_full):
+                    out = assemble(fetch(indices[sl]), genuine[sl])
                 self._pos = (epoch, nb_full + 1)
-                yield images, labels, valid
+                yield out
         finally:
             if pool:
                 pool.shutdown(wait=False)
@@ -362,18 +594,33 @@ class DevicePrefetcher:
     over the mesh's (data, fsdp) axes via
     ``jax.make_array_from_process_local_data`` — the multi-host-safe way to
     assemble a global batch.  A background thread keeps the pipeline full so
-    H2D copies overlap the train step (double-buffering; depth=2 default).
+    H2D copies overlap the train step (double/triple-buffering per ``depth``;
+    depth=2 default, depth=3 hides longer transfer tails).
+
+    Ring-buffer handoff: when the upstream produces pooled ring-buffer
+    batches (:class:`DataLoader`), the worker recycles each batch's
+    buffers the moment its device copy *completes* (``recycler`` —
+    auto-detected from the wrapped iterable's ``release_oldest``), so
+    steady-state host allocations are zero.  The handoff is
+    donation-safe by construction: pooled buffers are allocated off
+    XLA's zero-copy alignment grain and re-verified against the device
+    arrays before reuse, so a recycled buffer can never alias live
+    device data.
     """
 
     _DONE = object()
 
     def __init__(self, it: Any, depth: int = 2, sharding=None,
-                 track_loader: "DataLoader | None" = None):
+                 track_loader: "DataLoader | None" = None,
+                 recycler: Any = None):
         self.it = it
         if sharding is None:
             sharding = rt.current_runtime().data_sharding()
         self.sharding = sharding
         self.depth = max(1, depth)
+        if recycler is None and hasattr(it, "release_oldest"):
+            recycler = it
+        self.recycler = recycler
         # Mid-epoch-resume position of the batch most recently handed to
         # the CONSUMER.  The wrapped loader's own counter runs up to
         # ``depth`` batches ahead (the background thread prefetches), so
@@ -394,14 +641,27 @@ class DevicePrefetcher:
             )
         return dict(self._position)
 
+    #: XLA's CPU client zero-copies SMALL aligned host buffers at a finer
+    #: (16-byte) grain than large ones, so a tiny pooled leaf — labels,
+    #: valid masks — can alias its device shards even from a misaligned
+    #: base (a shard boundary inevitably lands on an aligned address).
+    #: Leaves at or under this size get a private copy before device_put:
+    #: the copy is what the device references, so the pooled buffer stays
+    #: recyclable.  Bytes-trivial; image buffers are far above it.
+    _SMALL_LEAF_BYTES = 4096
+
     def _put(self, batch):
         """Any pytree of host arrays (tuple / dict / nested) -> global Arrays."""
-        return jax.tree.map(
-            lambda x: jax.make_array_from_process_local_data(
-                self.sharding_for(np.asarray(x)), np.asarray(x)
-            ),
-            batch,
-        )
+
+        def to_global(x):
+            x = np.asarray(x)
+            if x.nbytes <= self._SMALL_LEAF_BYTES:
+                x = np.array(x)  # private copy: see _SMALL_LEAF_BYTES
+            return jax.make_array_from_process_local_data(
+                self.sharding_for(x), x
+            )
+
+        return jax.tree.map(to_global, batch)
 
     def sharding_for(self, x: np.ndarray):
         # batch-dim sharding only; trailing dims replicated
@@ -425,15 +685,15 @@ class DevicePrefetcher:
             return False
 
         def worker():
-            # span emit=False: the histograms (span/data/prefetch_fetch vs
-            # span/data/prefetch_put = produce vs H2D cost) and the live
-            # span stack (a stalled pipeline shows THIS thread's position
-            # in a watchdog report) matter; a JSONL event per batch would
-            # not.
+            # data/prefetch_fetch stays emit=False (histogram + live span
+            # stack only); data/h2d DOES emit — one JSONL event per batch
+            # with its wall-clock interval is exactly what proves the
+            # transfer of batch k+1 overlapped the step of batch k.
             tele = get_telemetry()
             prefetched = tele.registry.counter("data/batches_prefetched")
             try:
                 it = iter(self.it)
+                n = 0
                 while True:
                     with tele.span("data/prefetch_fetch", emit=False):
                         try:
@@ -449,9 +709,17 @@ class DevicePrefetcher:
                         if self.track_loader is not None
                         else None
                     )
-                    with tele.span("data/prefetch_put", emit=False):
+                    with tele.span("data/h2d", batch=n):
                         device_batch = self._put(batch)
+                        # wait for the copy itself (NOT any consumer
+                        # compute): after this the host buffers are free
+                        # to recycle, and span/data/h2d measures the real
+                        # transfer, not the dispatch
+                        jax.block_until_ready(device_batch)
+                    if self.recycler is not None:
+                        self.recycler.release_oldest(device_batch)
                     prefetched.inc()
+                    n += 1
                     if not put((device_batch, snap)):
                         return  # consumer went away
             except BaseException as e:  # propagate to consumer

@@ -243,8 +243,13 @@ class MDSWriter:
             body += datum
         packed = head + body
         # roll-first (mosaicml-streaming semantics): a shard never exceeds
-        # size_limit unless a single sample alone does
-        if self._samples and self._bytes + len(packed) > self.size_limit:
+        # size_limit unless a single sample alone does.  The limit counts
+        # the FULL shard file like mosaicml's accounting does — the
+        # 8-byte header (uint32 n + offsets[0]) and 4 bytes/sample of
+        # offset table — not just sample payloads (ADVICE r05 #1).
+        n_after = len(self._samples) + 1
+        shard_bytes = 8 + 4 * n_after + self._bytes + len(packed)
+        if self._samples and shard_bytes > self.size_limit:
             self._flush_shard()
         self._samples.append(packed)
         self._bytes += len(packed)

@@ -73,18 +73,35 @@ def _native_jpeg():
 
     Measured 1.7x PIL single-thread AND GIL-free (Pillow's decoders hold
     the GIL, capping thread-worker scaling at ~1 core); built once,
-    n_threads=1 because the DataLoader's worker pool already provides the
-    parallelism — a nested pool would oversubscribe.  Kill switch:
-    ``TPUFRAME_NATIVE_JPEG=0``.
+    n_threads=1 by default because the DataLoader's worker pool already
+    provides the parallelism — a nested pool would oversubscribe.
+    ``TPUFRAME_JPEG_THREADS=N`` widens the decoder's own pool for
+    low-worker setups (e.g. one loader worker feeding the ring on a
+    many-core host; `bench_decode.py --threads` measures the scaling
+    curve).  Kill switch: ``TPUFRAME_NATIVE_JPEG=0``.
     """
     global _JPEG_DECODER
     if _JPEG_DECODER == "unset":
         _JPEG_DECODER = None
         if os.environ.get("TPUFRAME_NATIVE_JPEG", "1") != "0":
+            # parse the knob OUTSIDE the build try: a typo'd value must
+            # warn and fall back to 1, not silently disable the native
+            # decoder the variable exists to tune
+            raw = os.environ.get("TPUFRAME_JPEG_THREADS", "1")
+            try:
+                n_threads = max(1, int(raw))
+            except ValueError:
+                import warnings
+
+                warnings.warn(
+                    f"TPUFRAME_JPEG_THREADS={raw!r} is not an integer; "
+                    "using 1", stacklevel=2,
+                )
+                n_threads = 1
             try:
                 from tpuframe.core.native import JpegDecoder
 
-                _JPEG_DECODER = JpegDecoder(n_threads=1)
+                _JPEG_DECODER = JpegDecoder(n_threads=n_threads)
             except Exception:
                 _JPEG_DECODER = None
     return _JPEG_DECODER

@@ -164,6 +164,28 @@ class Normalize:
         return (img.astype(np.float32) - self.mean) / self.std
 
 
+def uint8_image_transforms(
+    image_size: int,
+    random_flip: bool = True,
+    convert_rgb: bool = True,
+) -> Compose:
+    """Geometric-only pipeline that keeps samples uint8 end to end.
+
+    The host half of the uint8-over-PCIe path: decode -> resize -> flip
+    stay byte-sized, batches assemble into uint8 ring buffers
+    (``DataLoader(transfer_dtype="uint8")`` — 4x less host->HBM traffic
+    than f32), and the ``ToFloat``+``Normalize`` stages move on-device
+    as the fused ``tpuframe.ops.normalize_images`` kernel
+    (``Trainer(normalize=(IMAGENET_MEAN, IMAGENET_STD))``).
+    """
+    ts: list[Transform] = [Resize(image_size)]
+    if random_flip:
+        ts.append(RandomHorizontalFlip())
+    if convert_rgb:
+        ts.append(GrayscaleToRGB())
+    return Compose(ts)
+
+
 def default_image_transforms(
     image_size: int,
     normalize_transform: bool = True,

@@ -449,6 +449,11 @@ class Trainer:
             host_iter(),
             sharding=self.plan.batch_sharding(leading_microbatch=accum > 1),
             track_loader=loader if train and trackable else None,
+            # ring-buffer recycling: host_iter yields exactly one dict per
+            # loader batch (grad-accum reshapes within a batch), so the
+            # prefetcher's release-after-H2D stays FIFO-aligned with the
+            # loader's lease order
+            recycler=loader if hasattr(loader, "release_oldest") else None,
         )
         if train:
             self._train_prefetcher = pf
@@ -597,6 +602,14 @@ class Trainer:
         # *step* (train/step), not three.
         tele = get_telemetry()
         data_wait = dispatch = host_block = 0.0
+        # producer-side costs (assembly in the loader, H2D in the
+        # prefetcher thread) accrue in their span histograms; the delta
+        # over this epoch lands in the summary next to data_wait_s —
+        # together they attribute an input stall to production vs
+        # transfer vs consumption.
+        _h_assemble = tele.registry.histogram("span/data/assemble")
+        _h_h2d = tele.registry.histogram("span/data/h2d")
+        assemble0, h2d0 = _h_assemble.total, _h_h2d.total
         _epoch_end = object()
 
         def drain(window):
@@ -688,6 +701,8 @@ class Trainer:
         summary["data_wait_s"] = data_wait
         summary["dispatch_s"] = dispatch
         summary["host_block_s"] = host_block
+        summary["assemble_s"] = _h_assemble.total - assemble0
+        summary["h2d_s"] = _h_h2d.total - h2d0
         return summary
 
     def evaluate(self) -> dict[str, float]:
