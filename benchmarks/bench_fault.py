@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Fault-recovery benchmark: measured recovery, not assumed recovery.
+
+Two windows, one JSON line:
+
+1. **Recovery** — a seeded chaos injector kills a training run at a
+   mid-epoch step (loader raise: the in-process stand-in for a worker
+   kill — the same code path a dead worker pool surfaces through); the
+   :class:`tpuframe.fault.Supervisor` restarts it; the fresh Trainer
+   auto-resumes from the last mid-epoch snapshot.  Reported:
+   ``recovery_wall_s`` (failure -> first completed post-restart step:
+   re-init + checkpoint restore + recompile + step), ``resumed_step``
+   vs ``last_ckpt_step`` (the resume-exactness proof), and
+   ``lost_steps`` (work replayed because it post-dated the snapshot).
+
+2. **Checkpoint stall** — the same fit with no checkpointing, with
+   synchronous per-interval saves, and with ``async_save=True``:
+   per-save stall overhead and the epoch-time tax of each, i.e. the
+   number that justifies async checkpointing on real pods.
+
+CPU-friendly by design (tiny MnistNet on synthetic data) so the chaos
+path runs in CI; on a TPU host the same script measures the real
+restore + recompile cost (``capture_tpu_proofs.sh`` has the rung).
+
+Usage: python benchmarks/bench_fault.py [--steps-per-epoch N] [--epochs N]
+           [--snapshot-every N] [--kill-seed N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+
+def build_trainer(ds, ckpt, *, snapshot_every, epochs, callbacks=()):
+    from tpuframe.data import DataLoader
+    from tpuframe.models import MnistNet
+    from tpuframe.train import Trainer
+
+    return Trainer(
+        MnistNet(num_classes=4),
+        train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=3),
+        max_duration=f"{epochs}ep",
+        checkpointer=ckpt,
+        checkpoint_interval_batches=snapshot_every,
+        eval_interval=0,
+        log_interval=0,
+        callbacks=list(callbacks),
+    )
+
+
+def measure_recovery(workdir: str, args) -> dict:
+    """Window 1: seeded mid-epoch kill -> supervised restart -> resume."""
+    from tpuframe.ckpt import Checkpointer
+    from tpuframe.ckpt.checkpoint import latest_step
+    from tpuframe.data import SyntheticImageDataset
+    from tpuframe.fault import ChaosPlan, RestartPolicy, Supervisor
+    from tpuframe.train import Callback
+
+    ds = SyntheticImageDataset(
+        n=16 * args.steps_per_epoch, image_size=28, channels=1,
+        num_classes=4, seed=0,
+    )
+    ckpt_dir = os.path.join(workdir, "recovery_ck")
+    timeline: dict = {"attempt_first_step_t": [], "resume_start_step": []}
+
+    class Probe(Callback):
+        """First-completed-step wall-clock + the step each attempt
+        resumed at (read after maybe_restore, before any training)."""
+
+        def __init__(self):
+            self.saw_step = False
+
+        def on_fit_start(self, trainer) -> None:
+            import jax
+
+            self.saw_step = False
+            timeline["resume_start_step"].append(
+                int(jax.device_get(trainer.init_state().step))
+            )
+
+        def on_step_end(self, trainer) -> None:
+            if not self.saw_step:
+                self.saw_step = True
+                timeline["attempt_first_step_t"].append(time.perf_counter())
+
+    def attempt():
+        ck = Checkpointer(ckpt_dir)
+        try:
+            tr = build_trainer(
+                ds, ck, snapshot_every=args.snapshot_every,
+                epochs=args.epochs, callbacks=[Probe()],
+            )
+            res = tr.fit()
+            import jax
+
+            return int(jax.device_get(tr.state.step)), res
+        finally:
+            ck.close()
+
+    # seeded kill step: mid-epoch, strictly after the first snapshot so
+    # there is state to resume (reproduce any run by its --kill-seed)
+    plan = ChaosPlan.scheduled(
+        args.kill_seed,
+        sites=("loader",),
+        min_step=args.snapshot_every + 1,
+        max_step=args.steps_per_epoch * args.epochs - 1,
+    )
+    kill_step = plan.injectors[0].step
+    fail_t: list[float] = []
+    last_ckpt_step: list[int] = []
+
+    def on_restart(attempt_n, error):
+        fail_t.append(time.perf_counter())
+        last_ckpt_step.append(latest_step(ckpt_dir + "_intra") or 0)
+
+    sup = Supervisor(
+        RestartPolicy(max_restarts=2, backoff_base_s=0.0),
+        checkpoint_dir=ckpt_dir,
+        on_restart=on_restart,
+    )
+    t0 = time.perf_counter()
+    with plan.active():
+        final_step, result = sup.run(attempt)
+    total_s = time.perf_counter() - t0
+
+    # first completed step of attempt 2 minus the failure instant
+    recovery_wall_s = timeline["attempt_first_step_t"][1] - fail_t[0]
+    resumed_step = timeline["resume_start_step"][1]
+    return {
+        "kill_seed": args.kill_seed,
+        "kill_site": "loader",
+        "kill_step": kill_step,
+        "last_ckpt_step": last_ckpt_step[0],
+        "resumed_step": resumed_step,
+        "resume_exact": resumed_step == last_ckpt_step[0],
+        "lost_steps": kill_step - resumed_step,
+        "final_step": final_step,
+        "expected_final_step": args.steps_per_epoch * args.epochs,
+        "restarts": sup.retries,
+        "recovery_wall_s": round(recovery_wall_s, 3),
+        "total_wall_s": round(total_s, 3),
+    }
+
+
+def measure_ckpt_stall(workdir: str, args) -> dict:
+    """Window 2: per-save stall of sync vs async checkpointing."""
+    from tpuframe.ckpt import Checkpointer
+    from tpuframe.data import SyntheticImageDataset
+    from tpuframe.train import Callback
+
+    ds = SyntheticImageDataset(
+        n=16 * args.steps_per_epoch, image_size=28, channels=1,
+        num_classes=4, seed=0,
+    )
+
+    class StepClock(Callback):
+        """Wall time across the steady-state steps only (skips step 0's
+        compile, which would swamp a CPU-sized measurement)."""
+
+        def __init__(self):
+            self.t0 = None
+            self.t1 = None
+
+        def on_step_end(self, trainer) -> None:
+            now = time.perf_counter()
+            if self.t0 is None:
+                self.t0 = now
+            self.t1 = now
+
+        @property
+        def elapsed(self):
+            return (self.t1 or 0.0) - (self.t0 or 0.0)
+
+    def run(mode: str) -> tuple[float, int]:
+        from tpuframe.track.telemetry import get_telemetry
+
+        sub = os.path.join(workdir, f"stall_{mode}")
+        ck = None
+        if mode != "none":
+            ck = Checkpointer(
+                os.path.join(sub, "ck"), async_save=(mode == "async")
+            )
+        clock = StepClock()
+        saves = get_telemetry().registry.histogram("span/ckpt/save")
+        n0 = saves.count
+        try:
+            tr = build_trainer(
+                ds, ck,
+                snapshot_every=args.snapshot_every if ck else None,
+                epochs=args.epochs, callbacks=[clock],
+            )
+            tr.fit()
+            if ck is not None:
+                ck.wait()  # drain in-flight async writes before teardown
+        finally:
+            if ck is not None:
+                ck.close()
+        # the run's final epoch-end save lands after the last step, i.e.
+        # outside the steady-state clock window (same for both modes) —
+        # it dilutes per-save overhead, so it leaves the divisor too
+        return clock.elapsed, max(saves.count - n0 - 1, 1)
+
+    base, _ = run("none")
+    n_steps = args.steps_per_epoch * args.epochs
+    out = {"baseline_wall_s": round(base, 3), "n_steps": n_steps}
+    for mode in ("sync", "async"):
+        wall, n_saves = run(mode)
+        out[f"{mode}_wall_s"] = round(wall, 3)
+        out[f"{mode}_saves_in_window"] = n_saves
+        out[f"{mode}_overhead_per_save_s"] = round((wall - base) / n_saves, 4)
+        out[f"{mode}_stall_pct"] = round(100.0 * max(wall - base, 0.0) / wall, 1)
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps-per-epoch", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--snapshot-every", type=int, default=2)
+    p.add_argument("--kill-seed", type=int, default=7)
+    p.add_argument("--workdir", default=None)
+    args = p.parse_args(argv)
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="tpuframe_bench_fault_")
+
+    import jax
+
+    recovery = measure_recovery(workdir, args)
+    stall = measure_ckpt_stall(workdir, args)
+    print(json.dumps({
+        "metric": "fault_recovery_wall_s",
+        "value": recovery["recovery_wall_s"],
+        "unit": ("seconds from injected mid-epoch kill to first completed "
+                 "post-restart step (re-init + restore + recompile + step; "
+                 f"MnistNet 28px b16, {jax.default_backend()})"),
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "recovery": recovery,
+        "ckpt_stall": stall,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
 - ``tpuframe.launch``   — Distributor ``.run()`` + Ray-style TPUTrainer/Result
 - ``tpuframe.track``    — MLflow-compatible experiment tracking
 - ``tpuframe.ckpt``     — sharded checkpoint save/restore (orbax-backed)
+- ``tpuframe.fault``    — preemption watcher, chaos injection, supervised restarts
 - ``tpuframe.ops``      — Pallas TPU kernels for hot ops
 - ``tpuframe.serve``    — portable StableHLO inference artifacts (jax.export)
 """
@@ -27,6 +28,7 @@ _SUBMODULES = (
     "launch",
     "track",
     "ckpt",
+    "fault",
     "ops",
     "serve",
 )

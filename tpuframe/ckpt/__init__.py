@@ -17,15 +17,21 @@ TPU-native replacement for the reference's three checkpoint styles
 from tpuframe.ckpt.checkpoint import (
     Checkpointer,
     best_checkpoint_path,
+    is_committed,
     latest_step,
     load_pytree,
+    quarantine_torn_steps,
     save_pytree,
+    valid_steps,
 )
 
 __all__ = [
     "Checkpointer",
     "best_checkpoint_path",
+    "is_committed",
     "latest_step",
     "load_pytree",
+    "quarantine_torn_steps",
     "save_pytree",
+    "valid_steps",
 ]

@@ -23,9 +23,17 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tpuframe.fault import chaos
 from tpuframe.track.telemetry import get_telemetry
 
 _DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
+
+#: Files whose presence marks a step directory as *committed* — orbax
+#: writes one as the atomic last act of a save (`_CHECKPOINT_METADATA`
+#: since 0.5; `commit_success.txt` on non-atomic-rename filesystems like
+#: GCS).  A digit-named dir without one is torn: a save that died between
+#: data write and commit.
+COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
 
 
 def _state_data(state: Any) -> dict:
@@ -35,14 +43,78 @@ def _state_data(state: Any) -> dict:
     return {f: getattr(state, f) for f in _DATA_FIELDS}
 
 
-def latest_step(directory: str | os.PathLike) -> int | None:
-    """Highest numbered step dir under ``directory`` (None if empty/missing)."""
+def is_committed(step_dir: str | os.PathLike) -> bool:
+    """True iff ``step_dir`` carries a commit marker (a finished save)."""
+    return any(
+        os.path.exists(os.path.join(os.fspath(step_dir), m))
+        for m in COMMIT_MARKERS
+    )
+
+
+def valid_steps(directory: str | os.PathLike) -> list[int]:
+    """Sorted steps under ``directory`` whose saves actually committed.
+
+    Torn dirs (kill between data write and commit) and orbax's in-flight
+    ``*.orbax-checkpoint-tmp-*`` dirs are excluded — resuming from either
+    crash-loops into corrupt state.
+    """
     try:
         entries = os.listdir(directory)
-    except FileNotFoundError:
-        return None
-    steps = [int(e) for e in entries if e.isdigit()]
-    return max(steps) if steps else None
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    return sorted(
+        int(e)
+        for e in entries
+        if e.isdigit() and is_committed(os.path.join(os.fspath(directory), e))
+    )
+
+
+def latest_step(directory: str | os.PathLike) -> int | None:
+    """Highest *committed* step dir under ``directory`` (None if empty or
+    missing).  Counting any digit-named dir — including torn/in-flight
+    saves — would point auto-resume at unreadable state."""
+    steps = valid_steps(directory)
+    return steps[-1] if steps else None
+
+
+def quarantine_torn_steps(directory: str | os.PathLike) -> list[str]:
+    """Move torn step dirs into ``<directory>/_quarantine/`` (the
+    supervisor's pre-resume validation).  Moved aside, never deleted:
+    torn state is *evidence* (which leaves tore, how far the write got)
+    and partially-written arrays may still be salvageable by hand.
+    Returns the quarantined paths.  In-flight ``*-tmp-*`` dirs are left
+    alone.  On atomic-rename filesystems this can never race a live
+    async save: orbax stages the whole step in ``<step>.orbax-…-tmp-*``
+    and the digit dir only appears together with its commit marker
+    (measured on orbax 0.7) — a digit dir without one is genuinely torn.
+    On non-atomic backends (GCS-style, where ``commit_success.txt``
+    exists for this reason) avoid running validation concurrently with a
+    live async save.  Tmp dirs an interrupted save leaves behind are
+    garbage-collected by orbax itself on the next manager construction.
+    """
+    directory = os.fspath(directory)
+    try:
+        entries = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    moved: list[str] = []
+    tele = get_telemetry()
+    for e in entries:
+        src = os.path.join(directory, e)
+        if not (e.isdigit() and os.path.isdir(src)) or is_committed(src):
+            continue
+        qdir = os.path.join(directory, "_quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, e)
+        n = 0
+        while os.path.exists(dst):  # a step torn twice across restarts
+            n += 1
+            dst = os.path.join(qdir, f"{e}.{n}")
+        os.rename(src, dst)
+        moved.append(dst)
+        tele.registry.counter("fault/quarantined_steps").inc()
+        tele.event("fault/quarantine", step=int(e), src=src, dst=dst)
+    return moved
 
 
 class Checkpointer:
@@ -108,6 +180,8 @@ class Checkpointer:
         # hangs — under a watchdog it becomes an attributed stall report
         tele = get_telemetry()
         with tele.span("ckpt/save", step=int(step)), tele.guard("ckpt/save"):
+            chaos.maybe_fire("ckpt/save", step=int(step),
+                             directory=self.directory)
             self._mgr.save(
                 step,
                 args=ocp.args.Composite(
@@ -117,7 +191,12 @@ class Checkpointer:
                 metrics=metrics or None,
                 force=force,
             )
-        return os.path.join(self.directory, str(step))
+        path = os.path.join(self.directory, str(step))
+        # post-write injection point: TornCheckpoint tears the commit
+        # marker here, reproducing a kill between data write and commit
+        chaos.maybe_fire("ckpt/saved", step=int(step), path=path,
+                         directory=self.directory)
+        return path
 
     # -- restore -----------------------------------------------------------
     def restore(self, state: Any, step: int | None = None) -> tuple[Any, dict]:
@@ -128,7 +207,9 @@ class Checkpointer:
         Returns (new_state, meta_dict).
         """
         if step is None:
-            step = self._mgr.latest_step()
+            # newest *committed* step: orbax's own latest_step() counts
+            # torn digit-dirs, and restoring one fails mid-read
+            step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         template = _state_data(state)
@@ -148,21 +229,33 @@ class Checkpointer:
         return state.replace(**data), dict(extra.get("meta", {}))
 
     def maybe_restore(self, state: Any, step: int | None = None) -> tuple[Any, dict | None]:
-        """Restore if any checkpoint exists, else pass through (auto-resume)."""
-        if self._mgr.latest_step() is None:
+        """Restore if any *valid* checkpoint exists, else pass through
+        (auto-resume).  A directory holding only torn saves passes
+        through too — a fresh start beats a crash loop on corrupt state
+        (the supervisor's pre-resume validation additionally quarantines
+        the torn dirs so they stop shadowing real steps)."""
+        if self.latest_step() is None:
             return state, None
         new_state, meta = self.restore(state, step)
         return new_state, meta
 
     # -- queries -----------------------------------------------------------
     def latest_step(self) -> int | None:
-        return self._mgr.latest_step()
+        """Newest committed step (torn/in-flight saves don't count)."""
+        return latest_step(self.directory)
 
     def best_step(self) -> int | None:
-        return self._mgr.best_step()
+        """Best tracked step, only if its save actually committed — a
+        torn best would send restore-from-best into the same corrupt
+        state latest-step validation guards against."""
+        best = self._mgr.best_step()
+        if best is not None and best not in valid_steps(self.directory):
+            return None
+        return best
 
     def all_steps(self) -> list[int]:
-        return sorted(self._mgr.all_steps())
+        """Committed steps only (same validity contract as latest_step)."""
+        return valid_steps(self.directory)
 
     def delete(self, step: int) -> None:
         """Remove one step's checkpoint; a missing step is a no-op, any

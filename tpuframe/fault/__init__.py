@@ -1,0 +1,67 @@
+"""Fault tolerance: preemption handling, chaos injection, supervised restarts.
+
+The production-pretraining triad (TorchTitan, PAPERS.md) — recoverable
+training, validated checkpoints, failure-classified restarts — built
+natively on tpuframe's Checkpointer + telemetry spine:
+
+- ``fault.preempt``    — SIGTERM/maintenance-event watcher, step-boundary
+  last-chance checkpoints, multi-host agreement, :class:`Preempted` status
+- ``fault.chaos``      — deterministic seeded fault injection at named
+  call sites (loader raise, step stall, torn checkpoint, worker kill,
+  preemption notice) — recovery is *tested*, not assumed
+- ``fault.supervisor`` — restart orchestration: per-failure-class budgets,
+  exponential backoff with full jitter, pre-resume quarantine of torn
+  checkpoint steps
+
+Failure-mode catalog, injector reference and recovery runbook: FAULT.md.
+Like the telemetry spine it reports through, everything here except the
+multi-host agreement helper is stdlib-only and works while jax is wedged.
+"""
+
+from tpuframe.fault.chaos import (
+    ChaosError,
+    ChaosPlan,
+    Injector,
+    KillWorker,
+    PreemptNotice,
+    RaiseAt,
+    StallAt,
+    TornCheckpoint,
+)
+from tpuframe.fault.preempt import (
+    PREEMPTED_EXIT,
+    Preempted,
+    PreemptionWatcher,
+    gce_maintenance_poller,
+    preemption_requested,
+)
+from tpuframe.fault.supervisor import (
+    FailureClass,
+    RestartPolicy,
+    Supervisor,
+    backoff_delay,
+    classify_failure,
+    run_supervised,
+)
+
+__all__ = [
+    "ChaosError",
+    "ChaosPlan",
+    "FailureClass",
+    "Injector",
+    "KillWorker",
+    "PREEMPTED_EXIT",
+    "Preempted",
+    "PreemptNotice",
+    "PreemptionWatcher",
+    "RaiseAt",
+    "RestartPolicy",
+    "StallAt",
+    "Supervisor",
+    "TornCheckpoint",
+    "backoff_delay",
+    "classify_failure",
+    "gce_maintenance_poller",
+    "preemption_requested",
+    "run_supervised",
+]

@@ -1,0 +1,319 @@
+"""Deterministic chaos injection: seeded faults at named call sites.
+
+Fault tolerance that is never exercised is a guess.  This module makes
+tpuframe's recovery paths *testable on CPU*: instrumented call sites ask
+the active :class:`ChaosPlan` whether a fault is scheduled for
+``(site, step)`` and the plan fires it — raise, stall, corrupt a
+checkpoint, kill the process, or trip the preemption watcher.  Plans are
+built from explicit injector lists or drawn from a seed
+(:meth:`ChaosPlan.scheduled`), so a failing chaos test reproduces
+exactly.
+
+Instrumented sites (the hot-path cost with no active plan is one global
+read):
+
+=================  =========================================================
+site               where
+=================  =========================================================
+``loader``         Trainer._run_epoch, before pulling the next host batch
+``step``           Trainer._run_epoch, before dispatching the train step
+``ckpt/save``      Checkpointer.save, before the orbax write
+``ckpt/saved``     Checkpointer.save, after the write (ctx: ``path``) —
+                   where :class:`TornCheckpoint` tears the commit marker
+=================  =========================================================
+
+Library code can add sites with :func:`site`/:func:`maybe_fire`; tests
+activate a plan with ``with plan.active(): ...``.  Every firing emits a
+``fault/chaos_injected`` telemetry event and bumps the
+``fault/chaos_injections`` counter, so a chaos run's event log shows the
+injected fault right next to the recovery it triggered.
+
+Stdlib-only; never imports jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any, Iterator, Mapping, Sequence
+
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = [
+    "ChaosError",
+    "ChaosPlan",
+    "Injector",
+    "KillWorker",
+    "PreemptNotice",
+    "RaiseAt",
+    "StallAt",
+    "TornCheckpoint",
+    "active_plan",
+    "maybe_fire",
+    "site",
+]
+
+
+class ChaosError(OSError):
+    """Default injected failure type — an OSError subclass, so the stock
+    failure classifier treats it as retryable infra (the point of most
+    chaos runs is to drive the *recovery* path, not the fatal path)."""
+
+
+class Injector:
+    """One scheduled fault.
+
+    Args:
+      site: instrumented call-site name (table in the module docstring).
+      step: fire when the site reports this step; None = first visit.
+      times: how many visits fire (default 1 — a chaos plan is a script,
+        not a storm; schedule several injectors for several faults).
+    """
+
+    def __init__(self, site: str, step: int | None = None, *, times: int = 1):
+        self.site = site
+        self.step = step
+        self.times = times
+        self.fired = 0
+
+    def matches(self, site: str, step: int | None) -> bool:
+        if self.fired >= self.times or site != self.site:
+            return False
+        return self.step is None or step == self.step
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return (f"{type(self).__name__}(site={self.site!r}, "
+                f"step={self.step})")
+
+
+class RaiseAt(Injector):
+    """Raise at the site (default :class:`ChaosError` -> retryable infra).
+
+    ``exc`` may be an exception instance or type; a *fatal* type
+    (``ValueError``...) exercises the no-retry budget path instead.
+    """
+
+    def __init__(self, site: str, step: int | None = None, *,
+                 exc: BaseException | type = None, times: int = 1):
+        super().__init__(site, step, times=times)
+        self.exc = exc
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        exc = self.exc
+        if exc is None:
+            exc = ChaosError(
+                f"chaos: injected failure at {self.site} step {ctx.get('step')}"
+            )
+        elif isinstance(exc, type):
+            exc = exc(f"chaos: injected {exc.__name__} at {self.site}")
+        raise exc
+
+
+class StallAt(Injector):
+    """Sleep ``stall_s`` at the site — a wedged step-fn/collective in
+    miniature.  Pairs with the stall watchdog (TPUFRAME_WATCHDOG_S): the
+    injected hang should produce an attributed stall report."""
+
+    def __init__(self, site: str, step: int | None = None, *,
+                 stall_s: float = 1.0, times: int = 1):
+        super().__init__(site, step, times=times)
+        self.stall_s = float(stall_s)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        time.sleep(self.stall_s)
+
+
+class TornCheckpoint(Injector):
+    """Corrupt the just-written checkpoint into a torn (uncommitted) step.
+
+    Fires at ``ckpt/saved`` (ctx carries ``path``, the step directory)
+    and removes the orbax commit marker — exactly what a kill between
+    data write and commit leaves on disk.  The recovery contract under
+    test: ``latest_step``/``maybe_restore`` must skip this step and the
+    supervisor's pre-resume validation must quarantine it.
+
+    Requires a *synchronous* save: with ``async_save=True`` the site
+    fires before the background commit has written the marker, so there
+    is nothing to tear yet (and orbax commits afterwards) — that run
+    raises rather than letting the chaos test pass vacuously.
+    """
+
+    def __init__(self, step: int | None = None, *, times: int = 1):
+        super().__init__("ckpt/saved", step, times=times)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        from tpuframe.ckpt.checkpoint import COMMIT_MARKERS
+
+        path = ctx.get("path")
+        if not path:
+            return
+        torn = False
+        for marker in COMMIT_MARKERS:
+            try:
+                os.remove(os.path.join(path, marker))
+                torn = True
+            except FileNotFoundError:
+                pass
+        if not torn:
+            raise RuntimeError(
+                f"TornCheckpoint fired at {path} but found no commit "
+                "marker to tear — async_save=True? (the marker lands "
+                "after this site fires; tear a synchronous save instead)"
+            )
+
+
+class KillWorker(Injector):
+    """Kill this process at the site (default SIGKILL: no handlers, no
+    atexit — the hardest crash).  For subprocess/Distributor chaos tests;
+    an in-process test wants :class:`RaiseAt` instead."""
+
+    def __init__(self, site: str, step: int | None = None, *,
+                 sig: int = signal.SIGKILL, times: int = 1):
+        super().__init__(site, step, times=times)
+        self.sig = sig
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        os.kill(os.getpid(), self.sig)
+
+
+class PreemptNotice(Injector):
+    """Trip the process-wide preemption watcher at the site — a
+    deterministic SIGTERM stand-in.  The Trainer then runs its real
+    last-chance-checkpoint path at the next step boundary."""
+
+    def __init__(self, site: str = "step", step: int | None = None, *,
+                 times: int = 1):
+        super().__init__(site, step, times=times)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        from tpuframe.fault import preempt
+
+        watcher = preempt.active_watcher()
+        if watcher is None:
+            watcher = preempt.install()
+        watcher.request("chaos:PreemptNotice")
+
+
+class ChaosPlan:
+    """An ordered set of injectors + activation scoping.
+
+    Explicit: ``ChaosPlan([RaiseAt("loader", step=5)])``.
+    Seeded: :meth:`scheduled` draws injection steps deterministically
+    from a seed, so "chaos at a random step" is reproducible by seed.
+    """
+
+    def __init__(self, injectors: Sequence[Injector] = ()):
+        self.injectors = list(injectors)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def scheduled(
+        cls,
+        seed: int,
+        *,
+        max_step: int,
+        sites: Mapping[str, type | Injector] | Sequence[str] = ("loader",),
+        min_step: int = 1,
+    ) -> "ChaosPlan":
+        """One injector per site at a seed-deterministic step in
+        ``[min_step, max_step)``.  ``sites`` maps site name -> injector
+        class (default :class:`RaiseAt`); a plain sequence of names uses
+        the default everywhere."""
+        rng = random.Random(seed)
+        if not isinstance(sites, Mapping):
+            sites = {s: RaiseAt for s in sites}
+        injectors: list[Injector] = []
+        for name, kind in sorted(sites.items()):
+            step = rng.randrange(min_step, max(max_step, min_step + 1))
+            if isinstance(kind, Injector):
+                # the mapping key IS the site: an instance keeps its
+                # other knobs (stall_s, exc, times) but fires where the
+                # schedule says, at the drawn step
+                kind.site = name
+                kind.step = step
+                injectors.append(kind)
+            else:
+                injectors.append(kind(name, step) if kind is not TornCheckpoint
+                                 else kind(step))
+        return cls(injectors)
+
+    def maybe_fire(self, site_name: str, step: int | None = None,
+                   **ctx: Any) -> None:
+        """Fire every matching injector, at most once each per visit
+        (``times`` counts *visits*, so a ``times=5`` stall spreads over
+        five visits instead of collapsing into one).  Telemetry precedes
+        each fire (a KillWorker must leave its event in the log before
+        the process dies), and consumption is per-injector: when an
+        earlier injector raises, the ones after it keep their budget
+        instead of being silently spent unfired."""
+        with self._lock:
+            matched = [i for i in self.injectors if i.matches(site_name, step)]
+        for inj in matched:
+            with self._lock:
+                if not inj.matches(site_name, step):  # budget raced away
+                    continue
+                inj.fired += 1
+            tele = get_telemetry()
+            tele.registry.counter("fault/chaos_injections").inc()
+            tele.event(
+                "fault/chaos_injected",
+                site=site_name,
+                step=step,
+                injector=type(inj).__name__,
+            )
+            inj.fire({"site": site_name, "step": step, **ctx})
+
+    def fired_count(self) -> int:
+        with self._lock:
+            return sum(inj.fired for inj in self.injectors)
+
+    @contextlib.contextmanager
+    def active(self) -> Iterator["ChaosPlan"]:
+        """Activate process-wide for the block (plans don't nest: chaos
+        under chaos makes failures unattributable)."""
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError("a ChaosPlan is already active")
+            _ACTIVE = self
+        try:
+            yield self
+        finally:
+            with _ACTIVE_LOCK:
+                _ACTIVE = None
+
+
+# -- call-site hooks ----------------------------------------------------------
+
+_ACTIVE: ChaosPlan | None = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_plan() -> ChaosPlan | None:
+    return _ACTIVE
+
+
+def maybe_fire(site_name: str, step: int | None = None, **ctx: Any) -> None:
+    """The instrumented-call-site hook: no-op (one global read) unless a
+    plan is active and an injector matches."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.maybe_fire(site_name, step, **ctx)
+
+
+@contextlib.contextmanager
+def site(site_name: str, step: int | None = None, **ctx: Any) -> Iterator[None]:
+    """Context-manager form for wrapping a region::
+
+        with chaos.site("ckpt/save", step=step):
+            mgr.save(...)
+    """
+    maybe_fire(site_name, step, **ctx)
+    yield

@@ -1,0 +1,399 @@
+"""Preemption watcher: signals + pluggable maintenance polling -> one flag.
+
+On TPU pods, preemptions and maintenance events are routine operations,
+not failures — the platform sends SIGTERM (spot/preemptible reclaim) or
+publishes an upcoming maintenance window on the metadata server, and a
+production trainer has a short grace period to save and exit cleanly.
+The reference repo inherits this from Ray/Composer; tpuframe handles it
+natively:
+
+- :class:`PreemptionWatcher` owns a cross-thread flag.  ``install()``
+  registers SIGTERM/SIGINT handlers (chaining any previous callable
+  handler) and, when a ``poller`` is given, starts a daemon thread that
+  polls it — :func:`gce_maintenance_poller` reads the GCE metadata
+  server's ``maintenance-event`` key, and anything ``() -> bool`` plugs
+  in (a k8s preStop touch-file, a TPU-event API, a chaos injector).
+- The Trainer checks the flag at **step boundaries** (steps are the
+  atomic unit of progress; interrupting one mid-flight would tear the
+  optimizer state the checkpoint exists to protect), performs a
+  last-chance synchronous checkpoint, and raises :class:`Preempted` —
+  a ``BaseException`` so blanket ``except Exception`` recovery code
+  cannot swallow it on the way out.
+- :func:`agree` is the cheap multi-host collective: every host must save
+  the *same* step, but SIGTERM lands on hosts at different times.  The
+  loop is synchronous (each train step is a global collective), so an
+  all-gather of the local flag at the same step boundary on every host
+  yields the same verdict at the same step everywhere.
+
+Everything except :func:`agree` is stdlib-only and never imports jax —
+preemption notice must keep working while the backend is wedged (the
+two often arrive together: the reclaim that sends SIGTERM also yanks
+the TPU runtime out from under in-flight collectives).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, Callable, Iterable
+
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = [
+    "PREEMPTED_EXIT",
+    "Preempted",
+    "PreemptionWatcher",
+    "active_watcher",
+    "agree",
+    "gce_maintenance_poller",
+    "install",
+    "preemption_requested",
+    "reraise_for_exit",
+    "uninstall",
+]
+
+#: Exit code a preempted worker should exit with — distinguishable from
+#: crash (1), orphan (launch.agent.ORPHANED_EXIT=17) and SIGKILL (-9), so
+#: restart policies can tell "the platform took the machine" from "the
+#: code broke".  143 = 128+SIGTERM, the conventional graceful-term code.
+PREEMPTED_EXIT = 143
+
+
+class Preempted(BaseException):
+    """Raised at a step boundary after the last-chance checkpoint landed.
+
+    A ``BaseException`` (like KeyboardInterrupt): preemption is a control
+    signal, not an error — library code catching ``Exception`` to retry
+    or log must not eat it.  ``run_with_restarts``/``Supervisor`` classify
+    it separately from infra failures (its own restart budget, no
+    backoff: the replacement machine is ready when it is ready).
+    """
+
+    def __init__(self, reason: str = "preempted", *, step: int | None = None,
+                 checkpoint: str | None = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.step = step
+        self.checkpoint = checkpoint
+
+    def __repr__(self):
+        return (f"Preempted({self.reason!r}, step={self.step}, "
+                f"checkpoint={self.checkpoint!r})")
+
+
+class PreemptionWatcher:
+    """Cross-thread preemption flag fed by signals and/or a poller.
+
+    Args:
+      signals: signal numbers to trap on :meth:`install` (default
+        SIGTERM; pass ``(signal.SIGTERM, signal.SIGINT)`` to also catch
+        ctrl-C as a save-and-exit request).
+      poller: optional ``() -> bool``; polled from a daemon thread every
+        ``poll_interval_s`` until it first returns True (e.g.
+        :func:`gce_maintenance_poller`).  Exceptions from the poller are
+        swallowed — a flaky metadata server must not take training down.
+      poll_interval_s: poller cadence.
+    """
+
+    def __init__(
+        self,
+        *,
+        signals: Iterable[int] = (signal.SIGTERM,),
+        poller: Callable[[], bool] | None = None,
+        poll_interval_s: float = 5.0,
+    ):
+        self.signals = tuple(signals)
+        self.poller = poller
+        self.poll_interval_s = float(poll_interval_s)
+        self.reason: str | None = None
+        self._event = threading.Event()
+        self._notice_pending = False  # telemetry owed for a signal notice
+        self._prev_handlers: dict[int, Any] = {}
+        self._poll_thread: threading.Thread | None = None
+        self._stop_poll = threading.Event()
+        self._installed = False
+
+    # -- the flag ------------------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        if self._event.is_set():
+            self._flush_notice()
+        return self._event.is_set()
+
+    def request(self, reason: str = "requested") -> None:
+        """Set the flag (poller thread, chaos injector, or an external
+        orchestrator's direct call — the signal handler uses a deferred
+        path, see :meth:`_on_signal`)."""
+        if self._event.is_set():
+            return
+        self.reason = reason
+        self._event.set()
+        tele = get_telemetry()
+        tele.registry.counter("fault/preempt_notices").inc()
+        tele.event("fault/preempt_notice", reason=reason)
+
+    def _flush_notice(self) -> None:
+        """Emit the telemetry a signal-path notice deferred (always runs
+        in ordinary thread context, never inside a handler)."""
+        if self._notice_pending:
+            self._notice_pending = False
+            tele = get_telemetry()
+            tele.registry.counter("fault/preempt_notices").inc()
+            tele.event("fault/preempt_notice", reason=self.reason)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        hit = self._event.wait(timeout)
+        if hit:
+            self._flush_notice()
+        return hit
+
+    def clear(self) -> None:
+        """Re-arm after the notice was consumed (the supervisor does this
+        on an in-process preemption restart).  Restarts the maintenance
+        poll thread too — it exits on its first positive poll, and a
+        re-armed watcher that stopped polling would miss the *next*
+        maintenance event entirely."""
+        self._flush_notice()  # the notice happened; its record survives
+        self._event.clear()
+        self.reason = None
+        if self._installed and self.poller is not None:
+            self._start_poll_thread()
+
+    # -- wiring --------------------------------------------------------------
+    def install(self) -> "PreemptionWatcher":
+        """Register signal handlers + start the poll thread. Idempotent.
+
+        Signal registration only works on the main thread; elsewhere it
+        is skipped (the poller/``request`` paths still work), matching
+        how launch workers run user code on their main thread anyway.
+
+        Also registers as the process-wide watcher when none exists yet:
+        whoever consumes a :class:`Preempted` restart (the Supervisor)
+        finds this watcher via :func:`active_watcher` to clear its flag —
+        an explicitly-constructed watcher that stayed invisible would
+        re-preempt every in-process restart until the budget died.
+        """
+        global _ACTIVE
+        if self._installed:
+            return self
+        for sig in self.signals:
+            try:
+                prev = signal.signal(sig, self._on_signal)
+                self._prev_handlers[sig] = prev
+            except ValueError:  # not the main thread
+                break
+        if self.poller is not None:
+            self._start_poll_thread()
+        self._installed = True
+        with _LOCK:
+            if _ACTIVE is None:
+                _ACTIVE = self
+        return self
+
+    def _start_poll_thread(self) -> None:
+        if self._poll_thread is not None and self._poll_thread.is_alive():
+            return
+        self._stop_poll.clear()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="tpuframe-preempt-poll",
+            daemon=True,
+        )
+        self._poll_thread.start()
+
+    def uninstall(self) -> None:
+        """Restore previous signal handlers, stop the poller."""
+        global _ACTIVE
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers.clear()
+        self._stop_poll.set()
+        self._installed = False
+        with _LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    def _on_signal(self, signum, frame) -> None:
+        # Minimal-footprint handler.  CPython runs this on the main
+        # thread between bytecodes, so it can interrupt a frame that
+        # HOLDS the telemetry/registry locks the instrumented hot path
+        # takes every step — calling request() (which logs) from here
+        # could self-deadlock on a non-reentrant lock and burn the whole
+        # grace period.  Set the flag, mark the telemetry as owed, and
+        # let the first ordinary-context consumer (the Trainer's
+        # per-step `requested` read, `wait()`) emit it.
+        if not self._event.is_set():
+            self.reason = self.reason or f"signal:{signal.Signals(signum).name}"
+            self._notice_pending = True
+            self._event.set()
+        prev = self._prev_handlers.get(signum)
+        if callable(prev) and prev not in (signal.default_int_handler,):
+            prev(signum, frame)
+
+    def add_signals(self, signals: Iterable[int]) -> None:
+        """Trap additional signals on an already-installed watcher (the
+        bootstrap watcher is SIGTERM-only; user code may also want
+        SIGINT as a save-and-exit request)."""
+        for sig in signals:
+            if sig in self._prev_handlers:  # already trapped
+                continue
+            try:
+                prev = signal.signal(sig, self._on_signal)
+            except ValueError:  # not the main thread
+                return
+            self._prev_handlers[sig] = prev
+            if sig not in self.signals:
+                self.signals = self.signals + (sig,)
+
+    def add_poller(self, poller: Callable[[], bool],
+                   poll_interval_s: float | None = None) -> None:
+        """Attach (or replace) the poller; starts the poll thread when the
+        watcher is already installed.  Lets a bootstrap-installed
+        signal-only watcher gain maintenance polling later."""
+        self.poller = poller
+        if poll_interval_s is not None:
+            self.poll_interval_s = float(poll_interval_s)
+        if self._installed:
+            self._start_poll_thread()
+
+    def _poll_loop(self) -> None:
+        while not self._stop_poll.wait(self.poll_interval_s):
+            if self._event.is_set():
+                return
+            try:
+                if self.poller():
+                    self.request("maintenance-poll")
+                    return
+            except Exception:
+                pass  # flaky metadata endpoint: keep polling
+
+
+def gce_maintenance_poller(
+    url: str = ("http://metadata.google.internal/computeMetadata/v1/"
+                "instance/maintenance-event"),
+    timeout_s: float = 1.0,
+) -> Callable[[], bool]:
+    """Poller for GCE/TPU-VM maintenance events (metadata server).
+
+    Returns True when the metadata value is anything but ``NONE``
+    (``MIGRATE_ON_HOST_MAINTENANCE`` / ``TERMINATE_ON_HOST_MAINTENANCE``).
+    Stdlib urllib with a short timeout; unreachable metadata (non-GCE
+    host) reads as "no event".
+    """
+    import urllib.request
+
+    def poll() -> bool:
+        req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return resp.read().decode().strip().upper() not in ("", "NONE")
+        except Exception:
+            return False
+
+    return poll
+
+
+# -- the process-wide watcher -------------------------------------------------
+
+_ACTIVE: PreemptionWatcher | None = None
+_LOCK = threading.Lock()
+
+
+def install(
+    *,
+    signals: Iterable[int] = (signal.SIGTERM,),
+    poller: Callable[[], bool] | None = None,
+    poll_interval_s: float = 5.0,
+) -> PreemptionWatcher:
+    """Install (or return) the process-wide watcher.  The Trainer picks
+    it up automatically; launch workers install it during bootstrap
+    (disable with ``TPUFRAME_PREEMPT_SIGNALS=0``).
+
+    When a watcher already exists (the common case inside launch
+    workers, which install a SIGTERM-only one at bootstrap), the request
+    is merged into it rather than silently dropped: extra ``signals``
+    are trapped via :meth:`PreemptionWatcher.add_signals` and a
+    ``poller`` is attached/replaced via
+    :meth:`PreemptionWatcher.add_poller` — user code asking for SIGINT
+    or maintenance polling gets exactly that."""
+    w = _ACTIVE
+    if w is None:
+        # .install() registers itself as the process-wide watcher (under
+        # _LOCK); a concurrent installer losing the race just leaves an
+        # extra signal-chaining watcher, which is harmless
+        w = PreemptionWatcher(
+            signals=signals, poller=poller, poll_interval_s=poll_interval_s
+        ).install()
+        return _ACTIVE or w
+    w.add_signals(signals)
+    if poller is not None and poller is not w.poller:
+        w.add_poller(poller, poll_interval_s)
+    return w
+
+
+def active_watcher() -> PreemptionWatcher | None:
+    """The installed process-wide watcher, if any (never creates one)."""
+    return _ACTIVE
+
+
+def uninstall() -> None:
+    """Drop the process-wide watcher (tests)."""
+    global _ACTIVE
+    with _LOCK:
+        w, _ACTIVE = _ACTIVE, None
+    if w is not None:
+        w.uninstall()
+
+
+def preemption_requested() -> bool:
+    w = _ACTIVE
+    return w is not None and w.requested
+
+
+def reraise_for_exit(e: BaseException) -> None:
+    """Worker-entrypoint epilogue: re-raise ``e`` so the process exit
+    code classifies it — :class:`Preempted` becomes
+    ``SystemExit(PREEMPTED_EXIT)`` (143: the platform took the machine),
+    anything else re-raises as-is (ordinary crash, exit 1).  Call after
+    the typed result frame has been written/emitted; restart policies
+    that can read the frame still get the full exception."""
+    if isinstance(e, Preempted):
+        raise SystemExit(PREEMPTED_EXIT) from e
+    raise e
+
+
+def agree(local_flag: bool) -> bool:
+    """Multi-host agreement on "is anyone preempted?" — True everywhere
+    iff True anywhere.
+
+    Called at the same step boundary on every host (the train loop is
+    synchronous), so all hosts get the same verdict at the same step and
+    the last-chance checkpoint lands on one agreed step.  A process that
+    never imported jax is by definition not part of a multi-host jax
+    runtime, so it gets the local flag back without jax being imported
+    (or its backend initialized) here; with jax already live,
+    ``process_count() == 1`` likewise short-circuits to the local flag.
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return bool(local_flag)
+    if jax.process_count() == 1:
+        return bool(local_flag)
+    if jax.default_backend() == "cpu":
+        # XLA's CPU backend cannot run multiprocess computations, and
+        # multi-process-over-CPU is a test topology (real pods are
+        # TPU/GPU): degrade to local-only agreement rather than crash
+        # the loop it is guarding
+        return bool(local_flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([local_flag], dtype=np.int32)
+    )
+    return bool(np.asarray(flags).any())

@@ -1,0 +1,259 @@
+"""Restart orchestration: failure-classified budgets, jittered backoff,
+pre-resume checkpoint validation.
+
+Subsumes ``launch.elastic.run_with_restarts`` (which now delegates here).
+Three upgrades over the 58-line constant-backoff loop it replaces:
+
+1. **Failure classes, not one budget.**  A preemption is routine (the
+   platform took the machine) and restarts immediately under its own
+   generous budget; an infra failure (I/O, lost worker, runtime error)
+   retries with exponential backoff + full jitter; a code bug
+   (TypeError, ValueError, ...) never retries — rerunning a bug is how a
+   crash becomes a crash *loop*.
+2. **Backoff with jitter.**  Constant backoff synchronizes restart
+   storms across hosts hammering the same recovering dependency
+   (filesystem, rendezvous); ``delay = uniform(0, min(cap, base * 2^n))``
+   (AWS full jitter) decorrelates them.
+3. **Pre-resume checkpoint validation.**  A crash mid-save leaves a torn
+   step directory; auto-resume pointing at it crash-loops into corrupt
+   state.  Before every attempt the supervisor quarantines torn steps
+   (``ckpt.checkpoint.quarantine_torn_steps``) so ``maybe_restore``
+   lands on the newest *committed* step.
+
+Every decision is observable: ``fault/restart`` events carry the
+failure class, attempt number and delay; ``fault/restarts`` /
+``fault/preemptions`` counters accumulate; ``fault/giveup`` records why
+a run was allowed to die.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpuframe.fault.preempt import Preempted
+from tpuframe.track.telemetry import get_telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "FailureClass",
+    "RestartPolicy",
+    "Supervisor",
+    "backoff_delay",
+    "classify_failure",
+    "run_supervised",
+]
+
+
+class FailureClass(enum.Enum):
+    #: the platform reclaimed the machine — routine, restart immediately
+    PREEMPTION = "preemption"
+    #: transient infrastructure (I/O, lost worker, runtime) — backoff + retry
+    RETRYABLE = "retryable"
+    #: a code bug — retrying reruns the bug; surface it
+    FATAL = "fatal"
+
+
+#: Exception types that are never worth retrying (bugs, not infra).
+#: Superset of the old ``launch.elastic._FATAL``.
+FATAL_TYPES = (
+    KeyboardInterrupt,
+    SystemExit,
+    TypeError,
+    ValueError,
+    AttributeError,
+    NameError,
+    ImportError,
+)
+
+
+def classify_failure(exc: BaseException) -> FailureClass:
+    """Stock classifier: :class:`Preempted` -> PREEMPTION, known bug
+    types -> FATAL, everything else (OSError, RuntimeError — XLA surfaces
+    infra trouble as RuntimeError — lost workers, timeouts) -> RETRYABLE."""
+    if isinstance(exc, Preempted):
+        return FailureClass.PREEMPTION
+    if isinstance(exc, FATAL_TYPES):
+        return FailureClass.FATAL
+    return FailureClass.RETRYABLE
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_s: float = 1.0,
+    max_s: float = 60.0,
+    jitter: bool = True,
+    rng: random.Random | None = None,
+) -> float:
+    """Full-jitter exponential backoff (attempt counts from 1):
+    ``uniform(0, min(max_s, base_s * 2^(attempt-1)))``; ``jitter=False``
+    returns the cap itself (deterministic, for schedule tests)."""
+    if attempt < 1:
+        raise ValueError(f"attempt counts from 1, got {attempt}")
+    cap = min(float(max_s), float(base_s) * (2.0 ** (attempt - 1)))
+    if not jitter:
+        return cap
+    return (rng or random).uniform(0.0, cap)
+
+
+@dataclass
+class RestartPolicy:
+    """Budgets + backoff shape.  ``max_restarts`` bounds RETRYABLE
+    failures; ``max_preemptions`` bounds PREEMPTION separately (a healthy
+    job on spot capacity gets preempted many times without ever being
+    broken); FATAL has no budget — it never retries."""
+
+    max_restarts: int = 2
+    max_preemptions: int = 16
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 60.0
+    jitter: bool = True
+    #: seed for the jitter rng (None = nondeterministic, the production
+    #: default — determinism here would *recorrelate* host restarts)
+    seed: int | None = None
+    _rng: random.Random = field(init=False, repr=False, default=None)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed) if self.seed is not None else None
+
+    def delay_s(self, retry_attempt: int) -> float:
+        return backoff_delay(
+            retry_attempt,
+            base_s=self.backoff_base_s,
+            max_s=self.backoff_max_s,
+            jitter=self.jitter,
+            rng=self._rng,
+        )
+
+
+class Supervisor:
+    """Run a resumable fn under the restart policy.
+
+    ``fn`` must restore from its checkpointer on entry (the Trainer's
+    ``maybe_restore`` does) so a restart continues rather than recomputes.
+
+    Args:
+      policy: budgets + backoff (default :class:`RestartPolicy`).
+      checkpoint_dir: when given, validated before **every** attempt —
+        torn step directories are quarantined (moved aside, never
+        deleted) in both this directory and its ``_intra`` sibling, so
+        auto-resume lands on the newest committed step instead of
+        crash-looping into corrupt state.
+      classifier: exception -> :class:`FailureClass` (default
+        :func:`classify_failure`).
+      on_restart: ``(attempt, error)`` observability hook, called before
+        the backoff sleep (log, page, mark the run).
+      sleep: injectable for tests.
+    """
+
+    def __init__(
+        self,
+        policy: RestartPolicy | None = None,
+        *,
+        checkpoint_dir: str | None = None,
+        classifier: Callable[[BaseException], FailureClass] | None = None,
+        on_restart: Callable[[int, BaseException], None] | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.policy = policy or RestartPolicy()
+        self.checkpoint_dir = checkpoint_dir
+        self.classifier = classifier or classify_failure
+        self.on_restart = on_restart
+        self.sleep = sleep
+        self.retries = 0
+        self.preemptions = 0
+
+    # -- pre-resume validation ----------------------------------------------
+    def validate_checkpoints(self) -> list[str]:
+        """Quarantine torn steps under ``checkpoint_dir`` and its
+        ``_intra`` snapshot sibling; returns quarantined paths."""
+        if self.checkpoint_dir is None:
+            return []
+        from tpuframe.ckpt.checkpoint import quarantine_torn_steps
+
+        moved: list[str] = []
+        for d in (self.checkpoint_dir, str(self.checkpoint_dir) + "_intra"):
+            moved += quarantine_torn_steps(d)
+        return moved
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, fn: Callable[[], Any]) -> Any:
+        tele = get_telemetry()
+        while True:
+            quarantined = self.validate_checkpoints()
+            if quarantined:
+                logger.warning(
+                    "quarantined %d torn checkpoint step(s): %s",
+                    len(quarantined), quarantined,
+                )
+            try:
+                return fn()
+            except BaseException as e:
+                cls = self.classifier(e)
+                if cls is FailureClass.FATAL:
+                    tele.event("fault/giveup", reason="fatal",
+                               error=repr(e)[:300])
+                    raise
+                if cls is FailureClass.PREEMPTION:
+                    self.preemptions += 1
+                    attempt, budget = self.preemptions, self.policy.max_preemptions
+                    counter, delay = "fault/preemptions", 0.0
+                    # the notice is consumed by this restart: a real
+                    # preemption replaces the process (fresh flag), but a
+                    # single-host in-process restart shares the watcher —
+                    # left set, attempt N+1 would re-preempt at step 1
+                    from tpuframe.fault.preempt import active_watcher
+
+                    w = active_watcher()
+                    if w is not None:
+                        w.clear()
+                else:
+                    self.retries += 1
+                    attempt, budget = self.retries, self.policy.max_restarts
+                    counter = "fault/restarts"
+                    delay = self.policy.delay_s(self.retries)
+                if attempt > budget:
+                    tele.event(
+                        "fault/giveup", reason=f"{cls.value}-budget",
+                        attempts=attempt - 1, budget=budget,
+                        error=repr(e)[:300],
+                    )
+                    raise
+                tele.registry.counter(counter).inc()
+                tele.event(
+                    "fault/restart",
+                    failure_class=cls.value,
+                    attempt=attempt,
+                    budget=budget,
+                    delay_s=round(delay, 3),
+                    error=repr(e)[:300],
+                )
+                logger.warning(
+                    "train fn failed (%s, class=%s); restart %d/%d after %.2fs",
+                    repr(e), cls.value, attempt, budget, delay,
+                )
+                if self.on_restart is not None:
+                    # the hook keeps the old loop's contract: a single
+                    # monotonic restart count across classes (budgets are
+                    # per-class, but "restart N" in logs/pages must not
+                    # repeat or go backwards)
+                    self.on_restart(self.retries + self.preemptions, e)
+                if delay > 0:
+                    self.sleep(delay)
+
+
+def run_supervised(
+    fn: Callable[[], Any],
+    *,
+    policy: RestartPolicy | None = None,
+    checkpoint_dir: str | None = None,
+    **kwargs: Any,
+) -> Any:
+    """One-shot convenience: ``Supervisor(policy, ...).run(fn)``."""
+    return Supervisor(policy, checkpoint_dir=checkpoint_dir, **kwargs).run(fn)

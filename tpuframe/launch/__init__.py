@@ -18,7 +18,11 @@ TPU-native replacement for the reference's L5 launchers (SURVEY.md §1):
   01_fashion_mnist_pytorch_ray.ipynb:cell-6..cell-10`)
   -> :class:`TPUTrainer` with :func:`report` / :func:`get_context`.
 - Elastic recovery (absent in the reference, SURVEY.md §5) ->
-  :func:`run_with_restarts` checkpoint-resume restart loop.
+  :func:`run_with_restarts` checkpoint-resume restart loop, now backed by
+  :mod:`tpuframe.fault` (failure-classified budgets, jittered exponential
+  backoff, preemption handling, pre-resume checkpoint quarantine — see
+  FAULT.md).  Launch workers install the preemption watcher during
+  bootstrap (``TPUFRAME_PREEMPT_SIGNALS=0`` opts out).
 """
 
 from tpuframe.launch.distributor import (

@@ -8,6 +8,7 @@ stderr tail either way).
 
 from __future__ import annotations
 
+import os
 import pickle
 import sys
 
@@ -24,6 +25,15 @@ def main(payload_path: str, result_path: str) -> None:
 
     tele = get_telemetry()
     with tele.span("launch/worker_bootstrap"), tele.guard("launch/worker_bootstrap"):
+        # preemption watcher before the user fn: a SIGTERM during this
+        # run (spot reclaim, maintenance drain) becomes a flag the
+        # Trainer turns into a last-chance checkpoint + Preempted exit
+        # instead of an instant kill.  TPUFRAME_PREEMPT_SIGNALS=0 opts out.
+        if os.environ.get("TPUFRAME_PREEMPT_SIGNALS", "1") != "0":
+            from tpuframe.fault import preempt
+
+            preempt.install()
+
         # liveness beacon (before anything heavy: the driver should see
         # this rank alive while jax imports grind)
         from tpuframe.core.native import maybe_start_beacon
@@ -45,7 +55,12 @@ def main(payload_path: str, result_path: str) -> None:
         except Exception:
             outcome = {"ok": False, "error": RuntimeError(repr(e))}
         _write(result_path, outcome)
-        raise
+        # distinguishable exit: restart policies that only see the
+        # process (k8s, shell supervisors) can tell "the platform took
+        # the machine" (143) from "the code broke" (1)
+        from tpuframe.fault.preempt import reraise_for_exit
+
+        reraise_for_exit(e)
     _write(result_path, outcome)
 
 

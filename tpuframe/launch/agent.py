@@ -107,6 +107,15 @@ def main() -> None:
         raise SystemExit(1)
     _arm_orphan_watchdog()
 
+    # preemption watcher: a SIGTERM to this host's agent (spot reclaim,
+    # maintenance drain, `kubectl delete pod` grace period) sets the
+    # cross-thread flag the Trainer converts into a last-chance
+    # checkpoint + Preempted exit.  TPUFRAME_PREEMPT_SIGNALS=0 opts out.
+    if os.environ.get("TPUFRAME_PREEMPT_SIGNALS", "1") != "0":
+        from tpuframe.fault import preempt
+
+        preempt.install()
+
     if env.get("TPUFRAME_HB_PORT"):
         from tpuframe.core.native import maybe_start_beacon
 
@@ -131,7 +140,11 @@ def main() -> None:
             _emit({"ok": False, "error": e})
         except Exception:
             _emit({"ok": False, "error": RuntimeError(repr(e))})
-        raise
+        # distinguishable exit code (143): the driver's restart policy
+        # can classify a preempted host without unpickling the frame
+        from tpuframe.fault.preempt import reraise_for_exit
+
+        reraise_for_exit(e)
     _emit({"ok": True, "value": value})
 
 

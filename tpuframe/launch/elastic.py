@@ -1,23 +1,30 @@
 """Elastic recovery: checkpoint-resume restart loop.
 
-The reference has no fault tolerance (SURVEY.md §5: "No elastic logic";
-Ray merely *surfaces* failures via ``result.error``).  tpuframe's model:
-training state lives in a :class:`tpuframe.ckpt.Checkpointer` with
-auto-resume (``maybe_restore``), so recovery = rerun the train fn and let it
-pick up the latest checkpoint.  :func:`run_with_restarts` drives that loop
-with bounded retries and failure classification.
+Thin compatibility front for :mod:`tpuframe.fault.supervisor` — the
+original 58-line constant-backoff loop grew into a real subsystem
+(failure-classified budgets, exponential backoff with full jitter,
+pre-resume quarantine of torn checkpoints) and lives there now.  This
+entry point keeps the established signature: ``backoff_s`` is the *base*
+delay of the jittered exponential schedule, and ``retryable`` still
+overrides failure classification.
+
+tpuframe's recovery model is unchanged: training state lives in a
+:class:`tpuframe.ckpt.Checkpointer` with auto-resume (``maybe_restore``),
+so recovery = rerun the train fn and let it pick up the newest committed
+checkpoint.
 """
 
 from __future__ import annotations
 
-import logging
-import time
 from typing import Any, Callable
 
-logger = logging.getLogger(__name__)
-
-#: Exception types that are never worth retrying (bugs, not infra).
-_FATAL = (KeyboardInterrupt, SystemExit, TypeError, ValueError, AttributeError)
+from tpuframe.fault.supervisor import (
+    FATAL_TYPES as _FATAL,  # noqa: F401  (compat re-export)
+    FailureClass,
+    RestartPolicy,
+    Supervisor,
+    classify_failure,
+)
 
 
 def run_with_restarts(
@@ -27,32 +34,44 @@ def run_with_restarts(
     backoff_s: float = 1.0,
     retryable: Callable[[BaseException], bool] | None = None,
     on_restart: Callable[[int, BaseException], None] | None = None,
+    max_preemptions: int | None = None,
+    backoff_max_s: float = 60.0,
+    checkpoint_dir: str | None = None,
 ) -> Any:
     """Run ``fn`` until success or retry budget exhaustion.
 
     ``fn`` must be resumable — i.e. it restores from its checkpointer on
     entry (the Trainer's ``maybe_restore`` does this) so a restart continues
-    rather than recomputes.  ``retryable`` classifies failures (default:
-    anything except obvious code bugs); ``on_restart(attempt, error)`` is the
-    observability hook (log, page, mark the run).
+    rather than recomputes.  Failures are classified (preemption / retryable
+    infra / fatal code bug — ``fault.supervisor.classify_failure``);
+    ``retryable`` overrides the infra-vs-fatal split for non-preemption
+    failures.  Retry delays follow full-jitter exponential backoff with
+    ``backoff_s`` as the base and ``backoff_max_s`` the cap; preemption
+    restarts are immediate and draw on their own ``max_preemptions``
+    budget.  ``on_restart(attempt, error)`` is the observability hook
+    (log, page, mark the run); ``checkpoint_dir`` additionally enables
+    pre-resume validation (torn checkpoint steps are quarantined before
+    every attempt).
     """
+    classifier = None
+    if retryable is not None:
+        def classifier(e: BaseException) -> FailureClass:
+            cls = classify_failure(e)
+            if cls is FailureClass.PREEMPTION:
+                return cls
+            return (FailureClass.RETRYABLE if retryable(e)
+                    else FailureClass.FATAL)
 
-    def default_retryable(e: BaseException) -> bool:
-        return not isinstance(e, _FATAL)
-
-    retryable = retryable or default_retryable
-    attempt = 0
-    while True:
-        try:
-            return fn()
-        except BaseException as e:
-            if attempt >= max_restarts or not retryable(e):
-                raise
-            attempt += 1
-            logger.warning(
-                "train fn failed (%s); restart %d/%d after %.1fs",
-                repr(e), attempt, max_restarts, backoff_s,
-            )
-            if on_restart is not None:
-                on_restart(attempt, e)
-            time.sleep(backoff_s)
+    policy = RestartPolicy(
+        max_restarts=max_restarts,
+        backoff_base_s=backoff_s,
+        backoff_max_s=backoff_max_s,
+    )
+    if max_preemptions is not None:
+        policy.max_preemptions = max_preemptions
+    return Supervisor(
+        policy,
+        checkpoint_dir=checkpoint_dir,
+        classifier=classifier,
+        on_restart=on_restart,
+    ).run(fn)

@@ -32,6 +32,9 @@ import optax
 
 from tpuframe.core import runtime as rt
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
+from tpuframe.fault import chaos
+from tpuframe.fault import preempt as _preempt
+from tpuframe.fault.preempt import Preempted
 from tpuframe.track.telemetry import get_telemetry
 from tpuframe.parallel.precision import Policy, align_model_dtype, get_policy
 from tpuframe.parallel.sharding import ParallelPlan
@@ -102,6 +105,22 @@ class Trainer:
         position — a crash then auto-resumes with the very next batch
         (deterministic mid-epoch resume) instead of replaying the epoch.
       eval_interval: run eval every N epochs (0 = never).
+      preemption: preemption handling (``tpuframe.fault.preempt``).
+        None (default) uses the process-wide watcher when one is
+        installed (launch workers install it during bootstrap); True
+        installs the process-wide watcher at ``fit()``; False disables;
+        a :class:`~tpuframe.fault.PreemptionWatcher` instance is
+        installed at ``fit()`` and used directly.  On notice, the
+        Trainer finishes the in-flight step, writes a last-chance
+        synchronous snapshot (model/opt state + loader position, into
+        the ``_intra`` sibling directory) and raises
+        :class:`~tpuframe.fault.Preempted` — the supervisor restarts
+        the run on a fresh machine from exactly that step.
+      preempt_sync_steps: multi-host cadence (in steps) of the
+        preemption agreement collective — every host must save the same
+        step, so the flag check is an all-gather at a fixed step cadence
+        (single-process checks locally every step; the collective only
+        exists on pods).
     """
 
     def __init__(
@@ -134,6 +153,8 @@ class Trainer:
         grad_compression: str | None = None,
         normalize: tuple | None = None,
         ema_decay: float | None = None,
+        preemption: Any = None,
+        preempt_sync_steps: int = 16,
     ):
         if precision is None:
             # follow the model: an explicitly-bf16 model keeps bf16 compute
@@ -159,6 +180,22 @@ class Trainer:
         self.eval_interval = eval_interval
         self.log_interval = log_interval
         self.report = report
+        if preempt_sync_steps < 1:
+            raise ValueError(
+                f"preempt_sync_steps must be >= 1, got {preempt_sync_steps}"
+            )
+        if (
+            preemption is not None
+            and not isinstance(preemption, bool)
+            and not hasattr(preemption, "requested")
+        ):
+            raise ValueError(
+                "preemption must be None (auto), True (install the "
+                "process-wide watcher), False (disable), or a "
+                f"PreemptionWatcher; got {type(preemption).__name__}"
+            )
+        self.preemption = preemption
+        self.preempt_sync_steps = preempt_sync_steps
 
         if plan is None:
             plan = ParallelPlan(mesh=rt.current_runtime().mesh)
@@ -316,7 +353,7 @@ class Trainer:
         """Callbacks call this to end fit() after the current epoch."""
         self._stop_reason = reason
 
-    def _intra_checkpointer(self):
+    def _intra_checkpointer(self, create: bool = False):
         """Sibling checkpointer for mid-epoch snapshots, ``max_to_keep=1``.
 
         A SEPARATE directory keeps snapshots out of the main
@@ -336,14 +373,117 @@ class Trainer:
             # see that snapshot even if this run disabled the feature,
             # else a restart silently replays from an older epoch-end
             # checkpoint.  The path probe avoids creating the directory
-            # just to look.
-            if self.checkpoint_interval_batches or latest_step(intra_dir) is not None:
+            # just to look.  ``create`` forces construction (the
+            # preemption last-chance save needs a snapshot home even
+            # with interval snapshots off).
+            if (
+                create
+                or self.checkpoint_interval_batches
+                or latest_step(intra_dir) is not None
+            ):
                 self._intra_ck = Checkpointer(intra_dir, max_to_keep=1)
         return self._intra_ck
 
     def _emit(self, hook: str, *args) -> None:
         for cb in self.callbacks:
             getattr(cb, hook)(self, *args)
+
+    # -- preemption ----------------------------------------------------------
+    def _preempt_watcher(self):
+        if self.preemption is False:
+            return None
+        if self.preemption is None:
+            return _preempt.active_watcher()
+        return self.preemption
+
+    def _maybe_preempt_exit(self) -> None:
+        """Step-boundary preemption exit (``tpuframe.fault.preempt``).
+
+        Single-process: the local flag is checked every step.  Multi-host:
+        hosts learn of the notice at different times, but all must save
+        the SAME step — so the flag crosses hosts through a tiny
+        all-gather at a fixed step cadence (``preempt_sync_steps``),
+        entered by every host at the same step boundary (the loop is
+        synchronous).  On agreement: one synchronous snapshot (state +
+        consumer-true loader position, into the ``_intra`` sibling dir,
+        so auto-resume continues from this very step), then
+        :class:`Preempted` propagates out with the checkpoint path.
+        """
+        watcher = self._preempt_watcher()
+        multi_host = rt.process_count() > 1
+        if watcher is None and not multi_host:
+            return
+        local = watcher is not None and watcher.requested
+        if multi_host:
+            if self.batches_seen % self.preempt_sync_steps:
+                return
+            flagged = _preempt.agree(local)
+        else:
+            flagged = local
+        if not flagged:
+            return
+        reason = (watcher.reason if watcher is not None and watcher.reason
+                  else "peer-host")
+        tele = get_telemetry()
+        path = None
+        if self.checkpointer is not None:
+            intra = self._intra_checkpointer(create=True)
+            cur_step = int(jax.device_get(self.state.step))
+            if intra.latest_step() == cur_step:
+                # an interval snapshot already captured this exact step
+                path = str(intra.directory) + f"/{cur_step}"
+            else:
+                meta = {
+                    "epoch": self.epoch,
+                    "batches_seen": self.batches_seen,
+                    "samples_seen": self.samples_seen,
+                    "preempted": True,
+                }
+                if (
+                    self._train_prefetcher is not None
+                    and hasattr(self.train_dataloader, "state_dict")
+                ):
+                    meta["loader_state"] = self._train_prefetcher.state_dict()
+                elif self._train_prefetcher is not None:
+                    # mid-epoch with an untrackable loader: the snapshot
+                    # still beats losing the step, but resume replays
+                    # this epoch from its first batch.  Warn (raising
+                    # here would forfeit the last-chance save entirely —
+                    # unlike opt-in interval snapshots, which reject
+                    # untrackable loaders up front).
+                    import warnings
+
+                    warnings.warn(
+                        "preemption snapshot taken mid-epoch but the "
+                        f"train_dataloader ({type(self.train_dataloader).__name__}) "
+                        "has no state_dict(): resume will replay this "
+                        "epoch's already-trained batches",
+                        stacklevel=2,
+                    )
+                    meta["loader_state_missing"] = True
+                with tele.span(
+                    "fault/preempt_checkpoint", step=self.batches_seen
+                ), tele.guard("ckpt/save"):
+                    path = intra.save(self.state, meta=meta)
+                    intra.wait()  # synchronous: the machine is going away
+        # no counter here: fault/preempt_notices counted at the watcher,
+        # fault/preemptions at the supervisor's restart — incrementing a
+        # third time per event would double-read on dashboards
+        tele.event(
+            "fault/preempted",
+            reason=reason,
+            batch=self.batches_seen,
+            checkpoint=path,
+        )
+        self._stop_reason = f"preempted: {reason}"
+        if watcher is not None:
+            # the notice is fully acted on (checkpoint written): consume
+            # the flag HERE, on the watcher that was actually checked —
+            # an in-process supervised restart of a Trainer holding an
+            # explicit watcher must not re-preempt at its first boundary
+            # (a real preemption replaces the process; clearing is moot)
+            watcher.clear()
+        raise Preempted(reason, step=self.batches_seen, checkpoint=path)
 
     def _log_metrics(self, metrics: Mapping[str, float], step: int) -> None:
         if not self.is_main:
@@ -464,6 +604,13 @@ class Trainer:
         """Run to max_duration; returns the Ray-style FitResult."""
         result = FitResult()
         state = self.init_state()
+        if self.preemption is True:
+            # enable: ensure the process-wide watcher exists and use it
+            self.preemption = _preempt.install()
+        elif self.preemption is not None and self.preemption is not False:
+            # an explicitly-passed watcher: make sure its signal handlers
+            # / poll thread are live for the duration of the fit
+            self.preemption.install()
         if self.checkpointer is not None:
             # auto-resume from whichever is newer: the last epoch-end
             # checkpoint or a mid-epoch snapshot (crash inside an epoch)
@@ -622,6 +769,9 @@ class Trainer:
 
         batches = iter(self._device_batches(self.train_dataloader, train=True))
         while True:
+            # chaos site: a scheduled loader fault raises here, exactly
+            # where a real worker-pool / shard-fetch failure surfaces
+            chaos.maybe_fire("loader", step=self.batches_seen)
             with tele.span("train/data_wait", emit=False) as sp:
                 batch = next(batches, _epoch_end)
             if batch is _epoch_end:
@@ -630,6 +780,7 @@ class Trainer:
             if self._done() or self._stop_reason is not None:
                 break
             self._emit("on_step_start")
+            chaos.maybe_fire("step", step=self.batches_seen)
             # the guard turns a wedged dispatch (first-step compile, stuck
             # collective) into an attributed watchdog report instead of a
             # silent hang; unmonitored unless a watchdog is configured
@@ -668,6 +819,10 @@ class Trainer:
                             "loader_state": snap,
                         },
                     )
+            # step boundary = the preemption exit point: the step is the
+            # atomic unit of progress, so a SIGTERM/maintenance notice is
+            # acted on here — last-chance checkpoint, then Preempted out
+            self._maybe_preempt_exit()
             # Accumulate on device (async) — floating every step would
             # block the host on each step's completion and serialize the
             # pipeline.
