@@ -144,6 +144,13 @@ run bench_e2e_tpu_uint8.json   900  python benchmarks/bench_e2e.py --uint8-input
 # win (FAULT.md); cheap, so it rides above the long tail
 run bench_fault.json           300  python benchmarks/bench_fault.py
 
+# fleet-analysis rung: an instrumented fit analyzes its own telemetry
+# (cross-rank merge -> skew table -> Perfetto trace) and commits the
+# on-chip step_time block that `python -m tpuframe.track analyze
+# --baseline benchmarks/results/` regression-diffs future runs against;
+# cheap, so it rides with the fault rung above the long tail
+run analyze_selftest.json      300  python benchmarks/bench_analyze.py
+
 # input-side capacity, no chip required (VERDICT r05 weak #1/#2): the
 # producer ceiling per worker count and the native decode-thread scaling
 # curve — on the TPU host these calibrate "~N cores feed one chip"

@@ -1,0 +1,167 @@
+"""Regenerate the committed 4-rank telemetry fixture for the fleet analyzer.
+
+``tests/fixtures/analyze_fleet/`` is a synthetic ``TPUFRAME_TELEMETRY_DIR``
+exercising every analyzer feature deterministically (no RNG — jitter is a
+pure function of (rank, step)):
+
+- 4 ranks x 20 ``train/step`` spans (~100 ms baseline) with ``data_wait_s``
+  attrs, plus ``train/epoch`` spans and meta first lines (schema v1).
+- **rank 2 is the injected straggler**: steps 10-14 dispatch at 300 ms
+  (compute-bound) — the skew report must name it.
+- rank 3 stalls on input at step 6 (250 ms ``data_wait_s``): input-bound.
+- rank 0 runs a 400 ms ``ckpt/save`` inside step 17's boundary-to-boundary
+  window: checkpoint-bound.
+- **rank 1's wall clock steps +7.5 s mid-run** (a simulated NTP jump): its
+  ``ts`` fields are garbage after step 8 but its ``mono`` fields are
+  smooth, so anchor-pair alignment must still place its steps next to the
+  other ranks' — the reason the meta record exists.
+- rank 0's log is split across a rotated segment (``.1`` holds the first
+  half) to exercise segment-ordered reads.
+- a ``stall`` event on rank 2 and a ``fault/chaos_injected`` event on
+  rank 1 become instant events in the Perfetto trace.
+
+Run from the repo root::
+
+    python tests/fixtures/make_analyze_fixture.py
+"""
+
+import json
+import os
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "analyze_fleet")
+
+T0 = 1_754_000_000.0  # fixture epoch (wall), all ranks configure here
+N_RANKS = 4
+N_STEPS = 20
+BASE_DUR = 0.100
+BASE_WAIT = 0.004
+
+#: per-rank monotonic-clock epochs (arbitrary: each host boots at its own 0)
+ANCHOR_MONO = [100.0, 2500.5, 7.25, 41_000.125]
+
+NTP_JUMP_RANK, NTP_JUMP_AFTER_S, NTP_JUMP_S = 1, 1.0, 7.5
+SLOW_RANK, SLOW_STEPS, SLOW_DUR = 2, range(10, 15), 0.300
+INPUT_RANK, INPUT_STEP, INPUT_WAIT = 3, 6, 0.250
+CKPT_RANK, CKPT_STEP, CKPT_DUR = 0, 17, 0.400
+ROTATE_RANK, ROTATE_AT = 0, 10  # rank 0: steps < 10 land in the .1 segment
+
+
+def jitter(rank: int, step: int) -> float:
+    """Deterministic sub-ms noise so no two durations are exactly equal."""
+    return ((rank * 31 + step * 17) % 7) * 0.0004
+
+
+def wall(rank: int, g: float) -> float:
+    """Rank's (possibly wrong) wall clock reading at true global time g."""
+    t = T0 + (g - T0)
+    if rank == NTP_JUMP_RANK and g - T0 > NTP_JUMP_AFTER_S:
+        t += NTP_JUMP_S
+    return t
+
+
+def mono(rank: int, g: float) -> float:
+    """Rank's monotonic clock at true global time g (steady, by definition)."""
+    return ANCHOR_MONO[rank] + (g - T0)
+
+
+def rec(rank: int, g: float, body: dict) -> dict:
+    return {
+        "v": 1,
+        "ts": round(wall(rank, g), 6),
+        "mono": round(mono(rank, g), 6),
+        "rank": rank,
+        "pid": 1000 + rank,
+        "thread": "MainThread",
+        **body,
+    }
+
+
+def span(rank: int, g_end: float, name: str, dur: float, *,
+         stack=None, attrs=None) -> dict:
+    body = {
+        "kind": "span",
+        "name": name,
+        "stack": stack or ["train/epoch", name],
+        "dur_s": round(dur, 6),
+        "ok": True,
+    }
+    if attrs:
+        body["attrs"] = attrs
+    return rec(rank, g_end, body)
+
+
+def build_rank(rank: int) -> list[dict]:
+    recs = [
+        rec(rank, T0, {
+            "kind": "meta",
+            "name": "telemetry/meta",
+            "schema": 1,
+            "hostname": f"host{rank // 2}",
+            "anchor_wall": round(T0, 6),
+            "anchor_mono": round(ANCHOR_MONO[rank], 6),
+        })
+    ]
+    g = T0 + 0.010  # epoch starts shortly after configure
+    epoch_start = g
+    for step in range(N_STEPS):
+        dur = SLOW_DUR if (rank == SLOW_RANK and step in SLOW_STEPS) else BASE_DUR
+        wait = INPUT_WAIT if (rank == INPUT_RANK and step == INPUT_STEP) else BASE_WAIT
+        dur += jitter(rank, step)
+        g += wait
+        if rank == CKPT_RANK and step == CKPT_STEP:
+            # a mid-epoch snapshot between the wait and the dispatch: it
+            # lands inside this step's boundary-to-boundary window
+            g += CKPT_DUR
+            recs.append(span(rank, g, "ckpt/save", CKPT_DUR,
+                             stack=["train/epoch", "ckpt/save"],
+                             attrs={"step": step}))
+        g += dur
+        recs.append(span(rank, g, "train/step", dur,
+                         attrs={"batch": step, "data_wait_s": round(wait, 6)}))
+        if rank == 2 and step == 12:
+            recs.append(rec(rank, g, {
+                "kind": "stall", "name": "train/step",
+                "deadline_s": 0.12, "overdue_s": 0.18,
+                "spans": {"MainThread": ["train/epoch", "train/step"]},
+            }))
+        if rank == 1 and step == 4:
+            recs.append(rec(rank, g, {
+                "kind": "event", "name": "fault/chaos_injected",
+                "site": "step", "step": step, "injector": "StallAt",
+            }))
+    recs.append(span(rank, g, "train/epoch", g - epoch_start,
+                     stack=["train/epoch"], attrs={"epoch": 0}))
+    return recs
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    for rank in range(N_RANKS):
+        recs = build_rank(rank)
+        base = os.path.join(OUT, f"events-rank{rank}.jsonl")
+        if rank == ROTATE_RANK:
+            # split: meta + early steps in the rotated segment, the rest
+            # (headed by its own meta, as telemetry rotation writes) in
+            # the live file
+            cut = next(
+                i for i, r in enumerate(recs)
+                if r["kind"] == "span" and r["name"] == "train/step"
+                and r["attrs"]["batch"] == ROTATE_AT
+            )
+            with open(base + ".1", "w") as f:
+                for r in recs[:cut]:
+                    f.write(json.dumps(r) + "\n")
+            with open(base, "w") as f:
+                f.write(json.dumps(recs[0]) + "\n")  # rotation meta header
+                for r in recs[cut:]:
+                    f.write(json.dumps(r) + "\n")
+        else:
+            with open(base, "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+    n = sum(1 for _ in os.scandir(OUT))
+    print(f"wrote {n} files under {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,579 @@
+"""Fleet trace analysis (ISSUE 4): cross-rank merge -> Perfetto trace +
+skew table, clock alignment via meta anchors, rotated-segment reads,
+baseline regression diff, and live straggler detection (unit + a CPU fit
+with a chaos-stalled rank).
+
+The golden fixture under ``tests/fixtures/analyze_fleet/`` is committed
+(regenerate with ``python tests/fixtures/make_analyze_fixture.py``):
+4 ranks x 20 steps, rank 2 compute-slow on steps 10-14, rank 3
+input-stalled at step 6, rank 0 checkpoint-bound at step 17, rank 1's
+wall clock NTP-jumping +7.5s mid-run, rank 0's log rotation-split.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpuframe.track import analyze as A
+from tpuframe.track import telemetry as T
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "analyze_fleet")
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    T.reset()
+    yield
+    T.reset()
+
+
+@pytest.fixture()
+def cpu_runtime():
+    from tpuframe.core import MeshSpec
+    from tpuframe.core import runtime as rt
+
+    rt.reset_runtime()
+    rt.initialize(MeshSpec(data=-1))
+    yield
+    rt.reset_runtime()
+
+
+# -- loading + alignment ------------------------------------------------------
+
+
+class TestLoad:
+    def test_load_dir_finds_all_ranks(self):
+        ranks = A.load_dir(FIXTURE)
+        assert [r.rank for r in ranks] == [0, 1, 2, 3]
+        assert all(r.meta is not None for r in ranks)
+        assert ranks[0].hostname == "host0" and ranks[2].hostname == "host1"
+
+    def test_rotated_segments_merge_in_order(self):
+        # rank 0's log is split: steps 0-9 live in events-rank0.jsonl.1
+        rank0 = A.load_dir(FIXTURE)[0]
+        batches = [
+            e["attrs"]["batch"] for e in rank0.events
+            if e.get("kind") == "span" and e["name"] == "train/step"
+        ]
+        assert batches == list(range(20))  # oldest segment first, no dupes
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            A.load_dir(str(tmp_path))
+
+    def test_torn_trailing_line_is_skipped(self, tmp_path):
+        p = tmp_path / "events-rank0.jsonl"
+        good = json.dumps({"v": 1, "ts": 1.0, "mono": 1.0, "rank": 0,
+                           "pid": 1, "thread": "MainThread", "kind": "event",
+                           "name": "ok"})
+        p.write_text(good + "\n" + '{"v": 1, "ts": 2.0, "kind": "ev')
+        rl = A.load_rank(str(p))
+        assert [e["name"] for e in rl.events] == ["ok"]
+
+    def test_restart_appended_log_aligns_with_its_own_anchors(self, tmp_path):
+        """A restarted process appends a fresh meta whose monotonic epoch
+        restarted near zero (host reboot); its events must align with
+        ITS anchors, not the dead predecessor's."""
+        base = {"v": 1, "rank": 0, "thread": "MainThread"}
+        recs = [
+            {**base, "pid": 100, "kind": "meta", "name": "telemetry/meta",
+             "schema": 1, "anchor_wall": 1000.0, "anchor_mono": 500.0},
+            {**base, "pid": 100, "kind": "event", "name": "a",
+             "ts": 1010.0, "mono": 510.0},
+            # reboot: new pid, monotonic restarted at ~2, wall moved on
+            {**base, "pid": 200, "kind": "meta", "name": "telemetry/meta",
+             "schema": 1, "anchor_wall": 1100.0, "anchor_mono": 2.0},
+            {**base, "pid": 200, "kind": "event", "name": "b",
+             "ts": 1110.0, "mono": 12.0},
+        ]
+        p = tmp_path / "events-rank0.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        rl = A.load_rank(str(p))
+        a, b = rl.events
+        assert rl.end_time(a) == pytest.approx(1010.0)
+        # with the stale first-meta offset this would land at 12+500=512
+        assert rl.end_time(b) == pytest.approx(1110.0)
+
+    def test_anchor_alignment_survives_wall_clock_jump(self):
+        """Rank 1's ts fields step +7.5s mid-run; mono+anchor placement
+        must keep its late steps next to the other ranks' (the whole
+        point of the meta anchor pair)."""
+        ranks = A.load_dir(FIXTURE)
+        by_rank = {r.rank: r for r in ranks}
+
+        def step_end(rank, batch):
+            for e in by_rank[rank].events:
+                if (e.get("kind") == "span" and e["name"] == "train/step"
+                        and e.get("attrs", {}).get("batch") == batch):
+                    return by_rank[rank].end_time(e), e["ts"]
+            raise AssertionError(f"no step {batch} on rank {rank}")
+
+        aligned1, raw_ts1 = step_end(1, 19)
+        aligned0, _ = step_end(0, 19)
+        # aligned: within the fleet's natural stagger
+        assert abs(aligned1 - aligned0) < 0.5
+        # while the raw wall ts is ~7.5s off — i.e. alignment did something
+        assert abs(raw_ts1 - aligned0) > 7.0
+
+
+# -- skew report --------------------------------------------------------------
+
+
+class TestSkewReport:
+    @pytest.fixture(scope="class")
+    def report(self):
+        return A.skew_report(A.load_dir(FIXTURE))
+
+    def test_names_the_injected_slowest_rank(self, report):
+        # 20 fixture steps minus the default warmup (compile) skip
+        assert report["ranks"] == 4 and report["steps"] == 19
+        assert report["warmup_steps_skipped"] == 1
+        assert report["slowest"]["rank"] == 2  # the acceptance criterion
+        assert report["slowest"]["times_slowest"] == 5  # steps 10-14
+        assert report["total_lost_s"] > 0.9
+
+    def test_per_step_rows_classify_boundedness(self, report):
+        rows = {r["batch"]: r for r in report["per_step"]}
+        assert rows[6]["slowest_rank"] == 3
+        assert rows[6]["bound"] == "input" and rows[6]["straggling"]
+        for b in range(10, 15):
+            assert rows[b]["slowest_rank"] == 2
+            assert rows[b]["bound"] == "compute" and rows[b]["straggling"]
+        assert rows[17]["slowest_rank"] == 0
+        assert rows[17]["bound"] == "checkpoint" and rows[17]["straggling"]
+        # a healthy step straggles nowhere
+        assert not rows[3]["straggling"] and rows[3]["lost_s"] < 0.01
+
+    def test_lost_time_attributed_by_cause(self, report):
+        lb = report["lost_by_bound"]
+        assert lb["compute"] > lb["checkpoint"] > 0
+        assert lb["input"] > 0.2
+        # the by-cause breakdown decomposes exactly the straggler share
+        assert sum(lb.values()) == pytest.approx(
+            report["straggler_lost_s"], abs=1e-4
+        )
+        assert report["total_lost_s"] >= report["straggler_lost_s"]
+
+    def test_step_time_distribution(self, report):
+        st = report["step_time"]
+        assert st["count"] == 76  # 4 ranks x 19 post-warmup steps
+        assert 0.09 < st["p50"] < 0.12
+        assert st["p95"] >= 0.3  # the straggler steps are in the tail
+
+    def test_warmup_zero_keeps_every_step(self):
+        report = A.skew_report(A.load_dir(FIXTURE), warmup_steps=0)
+        assert report["steps"] == 20
+        assert report["step_time"]["count"] == 80
+
+    def test_format_report_is_readable(self, report):
+        text = A.format_report(report)
+        assert "slowest rank: 2" in text
+        assert "input" in text and "checkpoint" in text
+
+
+# -- Perfetto trace -----------------------------------------------------------
+
+
+class TestTrace:
+    @pytest.fixture(scope="class")
+    def trace(self):
+        return A.build_trace(A.load_dir(FIXTURE))
+
+    def test_valid_json_with_one_track_per_rank(self, trace):
+        loaded = json.loads(json.dumps(trace))  # must survive a round trip
+        names = [e for e in loaded["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert len(names) == 4  # the acceptance criterion: 4 rank tracks
+        assert sorted(e["args"]["name"] for e in names) == [
+            "rank 0 @ host0", "rank 1 @ host0",
+            "rank 2 @ host1", "rank 3 @ host1",
+        ]
+        assert loaded["otherData"]["ranks"] == 4
+
+    def test_spans_are_complete_events_with_microsecond_times(self, trace):
+        steps = [e for e in trace["traceEvents"]
+                 if e.get("ph") == "X" and e["name"] == "train/step"]
+        assert len(steps) == 80
+        for e in steps:
+            assert e["ts"] >= 0 and e["dur"] > 0
+            assert "batch" in e["args"]
+        # rank 2's slow steps are visibly ~3x longer
+        slow = [e for e in steps if e["pid"] == 2 and e["args"]["batch"] == 12]
+        assert slow[0]["dur"] > 2.5 * 100_000 / 1e3 * 1e3  # > 250ms in us
+
+    def test_stalls_and_faults_become_instant_events(self, trace):
+        inst = {(e["pid"], e["name"]) for e in trace["traceEvents"]
+                if e.get("ph") == "i"}
+        assert (2, "train/step") in inst  # the stall record
+        assert (1, "fault/chaos_injected") in inst
+
+    def test_large_span_attrs_are_clipped_in_args(self, tmp_path):
+        d = _mklog(tmp_path, [
+            {"ts": 1.0, "mono": 1.0, "kind": "span", "name": "x",
+             "dur_s": 0.1, "ok": True, "attrs": {"detail": "y" * 5000}},
+        ])
+        trace = A.build_trace(A.load_dir(d))
+        ev = [e for e in trace["traceEvents"] if e.get("ph") == "X"][0]
+        assert len(ev["args"]["detail"]) <= 400
+
+    def test_thread_metadata_present(self, trace):
+        threads = [e for e in trace["traceEvents"]
+                   if e.get("ph") == "M" and e["name"] == "thread_name"]
+        assert {e["args"]["name"] for e in threads} == {"MainThread"}
+        assert len(threads) == 4
+
+
+def _mklog(tmp_path, records, rank=0):
+    path = tmp_path / f"events-rank{rank}.jsonl"
+    base = {"v": 1, "rank": rank, "pid": 100, "thread": "MainThread"}
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps({**base, **r}) + "\n")
+    return str(tmp_path)
+
+
+def _step(batch, end, dur=0.1, wait=0.004, pid=100):
+    return {"ts": end, "mono": end, "pid": pid, "kind": "span",
+            "name": "train/step", "dur_s": dur, "ok": True,
+            "attrs": {"batch": batch, "data_wait_s": wait}}
+
+
+class TestStepWallStructuralGuards:
+    """The boundary-to-boundary period is only rejected for structural
+    reasons (restart pid change, epoch boundary) — never for being big:
+    a 10s checkpoint stall between 0.1s steps is exactly the thing the
+    skew report exists to surface."""
+
+    def test_huge_checkpoint_stall_is_charged_and_classified(self, tmp_path):
+        d = _mklog(tmp_path, [
+            _step(0, 100.0),
+            {"ts": 109.9, "mono": 109.9, "kind": "span", "name": "ckpt/save",
+             "dur_s": 9.8, "ok": True, "attrs": {"step": 1}},
+            _step(1, 110.0),  # 100x the nominal step wall
+        ])
+        rows = {r["batch"]: r for r in A.skew_report(A.load_dir(d))["per_step"]}
+        assert rows[1]["max_s"] == pytest.approx(10.0, rel=0.01)
+        assert rows[1]["bound"] == "checkpoint"
+
+    def test_epoch_boundary_gap_is_not_one_steps_cost(self, tmp_path):
+        d = _mklog(tmp_path, [
+            _step(0, 100.0),
+            {"ts": 100.1, "mono": 100.1, "kind": "span", "name": "train/epoch",
+             "dur_s": 2.0, "ok": True, "attrs": {"epoch": 0}},
+            _step(1, 130.0),  # 30s of eval/ckpt between epochs
+        ])
+        rows = {r["batch"]: r for r in A.skew_report(A.load_dir(d))["per_step"]}
+        assert rows[1]["max_s"] == pytest.approx(0.104, rel=0.01)
+
+    def test_restart_gap_is_not_one_steps_cost(self, tmp_path):
+        d = _mklog(tmp_path, [
+            _step(0, 100.0, pid=100),
+            _step(1, 400.0, pid=200),  # a new process resumed the run
+        ])
+        rows = {r["batch"]: r for r in A.skew_report(A.load_dir(d))["per_step"]}
+        assert rows[1]["max_s"] == pytest.approx(0.104, rel=0.01)
+
+
+# -- baseline diff ------------------------------------------------------------
+
+
+class TestBaselineDiff:
+    def _report(self):
+        return A.skew_report(A.load_dir(FIXTURE))  # p50 ~ 0.10s
+
+    def test_regression_flagged_against_faster_baseline(self, tmp_path):
+        (tmp_path / "old.json").write_text(json.dumps(
+            {"metric": "x", "backend": "cpu",
+             "step_time": {"p50": 0.010, "p95": 0.012}}
+        ))
+        (tmp_path / "irrelevant.json").write_text(json.dumps(
+            {"metric": "decode", "value": 1.0}  # no step_time: skipped
+        ))
+        diff = A.baseline_diff(self._report(), str(tmp_path))
+        assert len(diff["baselines"]) == 1
+        assert diff["regressions"] and diff["baselines"][0]["ratio_p50"] > 5
+
+    def test_ok_against_slower_baseline(self, tmp_path):
+        (tmp_path / "old.json").write_text(json.dumps(
+            {"step_time": {"p50": 0.5, "p95": 0.6}}
+        ))
+        diff = A.baseline_diff(self._report(), str(tmp_path))
+        assert diff["baselines"] and not diff["regressions"]
+
+    def test_backend_filter_skips_cross_backend_baselines(self, tmp_path):
+        """A CPU run diffed against a TPU record is not a regression."""
+        (tmp_path / "tpu.json").write_text(json.dumps(
+            {"backend": "tpu", "step_time": {"p50": 0.002, "p95": 0.003}}
+        ))
+        (tmp_path / "cpu.json").write_text(json.dumps(
+            {"backend": "cpu", "step_time": {"p50": 0.2, "p95": 0.3}}
+        ))
+        (tmp_path / "nobackend.json").write_text(json.dumps(
+            {"step_time": {"p50": 0.2, "p95": 0.3}}  # always compared
+        ))
+        diff = A.baseline_diff(self._report(), str(tmp_path), backend="cpu")
+        assert {b["file"] for b in diff["baselines"]} == {
+            "cpu.json", "nobackend.json"
+        }
+        assert not diff["regressions"]
+        # without the filter the TPU record trips a spurious regression
+        diff = A.baseline_diff(self._report(), str(tmp_path))
+        assert any(b["file"] == "tpu.json" for b in diff["regressions"])
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestCli:
+    def test_analyze_writes_trace_and_report(self, tmp_path, capsys):
+        out = tmp_path / "trace.json"
+        rc = A.main([FIXTURE, "--trace", str(out), "--report"])
+        assert rc == 0
+        trace = json.loads(out.read_text())
+        tracks = [e for e in trace["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert len(tracks) == 4
+        text = capsys.readouterr().out
+        assert "slowest rank: 2" in text
+
+    def test_module_entrypoint_dispatches(self, capsys):
+        from tpuframe.track.__main__ import main as track_main
+
+        assert track_main([FIXTURE[:0] or "bogus"]) == 2  # unknown command
+        assert track_main(["analyze", FIXTURE]) == 0
+        assert "slowest rank: 2" in capsys.readouterr().out
+
+    def test_regression_exit_code(self, tmp_path, capsys):
+        base = tmp_path / "results"
+        base.mkdir()
+        (base / "fast.json").write_text(json.dumps(
+            {"step_time": {"p50": 0.001, "p95": 0.002}}
+        ))
+        rc = A.main([FIXTURE, "--report", "--baseline", str(base)])
+        assert rc == 3
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_missing_dir_is_a_clean_error(self, tmp_path, capsys):
+        assert A.main([str(tmp_path / "nope")]) == 2
+
+
+# -- live straggler monitor (units) -------------------------------------------
+
+
+class TestStragglerMonitor:
+    def test_fleet_mode_names_the_slow_rank(self):
+        tele = T.configure()
+        mon = A.StragglerMonitor(
+            factor=2.0, sync_steps=1, min_steps=1, skip_first=0,
+            gather=lambda v: [0.1, 0.1, 0.1, 0.4], rank=0,
+        )
+        det = mon.observe(0.1)
+        assert det is not None
+        assert det["rank"] == 3 and det["mode"] == "fleet"
+        assert det["ratio"] == pytest.approx(4.0)
+        assert tele.registry.gauge("train/skew_ratio").value == pytest.approx(4.0)
+        evs = [e for e in tele.recent_events() if e["name"] == "train/straggler"]
+        assert evs and evs[0]["rank"] == 3
+        assert tele.registry.counter("train/stragglers").value == 1
+
+    def test_only_rank0_emits_the_fleet_event(self):
+        tele = T.configure()
+        mon = A.StragglerMonitor(
+            factor=2.0, sync_steps=1, min_steps=1, skip_first=0,
+            gather=lambda v: [0.1, 0.1, 0.1, 0.4], rank=2,
+        )
+        det = mon.observe(0.1)
+        assert det is not None and det["rank"] == 3  # every rank knows
+        assert not [e for e in tele.recent_events()
+                    if e["name"] == "train/straggler"]  # but only 0 speaks
+
+    def test_self_mode_detects_a_rank_going_slow(self):
+        T.configure()
+        mon = A.StragglerMonitor(
+            factor=3.0, sync_steps=4, min_steps=8, skip_first=0, rank=0,
+            gather=lambda v: [v],  # degraded: single-process topology
+        )
+        det = None
+        for _ in range(10):
+            det = mon.observe(0.01) or det
+        assert det is None  # healthy history: no detection
+        for _ in range(6):
+            det = mon.observe(0.5) or det
+        assert det is not None and det["mode"] == "self"
+        assert det["ratio"] > 3.0
+
+    def test_below_factor_sets_gauge_but_no_event(self):
+        tele = T.configure()
+        mon = A.StragglerMonitor(
+            factor=5.0, sync_steps=1, min_steps=1, skip_first=0,
+            gather=lambda v: [0.1, 0.12], rank=0,
+        )
+        assert mon.observe(0.1) is None
+        assert tele.registry.gauge("train/skew_ratio").value > 1.0
+        assert not [e for e in tele.recent_events()
+                    if e["name"] == "train/straggler"]
+
+    def test_disabled_by_zero_sync_steps(self):
+        mon = A.StragglerMonitor(factor=2.0, sync_steps=0, min_steps=1,
+                                 skip_first=0, gather=lambda v: [9.0, 0.1])
+        assert not mon.enabled
+        assert mon.observe(9.0) is None
+
+    def test_env_knobs_resolve(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_STRAGGLER_STEPS", "7")
+        monkeypatch.setenv("TPUFRAME_STRAGGLER_FACTOR", "3.5")
+        mon = A.StragglerMonitor()
+        assert mon.sync_steps == 7 and mon.factor == 3.5
+
+    def test_ewma_gauge_published(self):
+        tele = T.configure()
+        mon = A.StragglerMonitor(sync_steps=0, skip_first=0)
+        mon.observe(0.2)
+        mon.observe(0.2)
+        assert tele.registry.gauge("train/step_ewma_s").value == pytest.approx(0.2)
+
+
+# -- live straggler acceptance: CPU fit with a chaos-stalled rank -------------
+
+
+def _tiny_fit_with_stalls(tmp_path, stall_steps, stall_s):
+    from tpuframe.data import DataLoader, SyntheticImageDataset
+    from tpuframe.fault import ChaosPlan, StallAt
+    from tpuframe.models import MnistNet
+    from tpuframe.train import Trainer
+
+    tele = T.configure(jsonl_dir=str(tmp_path), rank=0)
+    ds = SyntheticImageDataset(
+        n=16 * 16, image_size=28, channels=1, num_classes=4, seed=0
+    )
+    trainer = Trainer(
+        MnistNet(num_classes=4),
+        train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=3),
+        max_duration="1ep",
+        eval_interval=0,
+        log_interval=0,
+        straggler_sync_steps=4,
+        straggler_factor=2.5,
+    )
+    plan = ChaosPlan(
+        [StallAt("step", step=s, stall_s=stall_s) for s in stall_steps]
+    )
+    with plan.active():
+        trainer.fit()
+    return tele, trainer
+
+
+def test_live_chaos_stalled_rank_emits_straggler_events(tmp_path, cpu_runtime):
+    """ISSUE acceptance: a live CPU run whose rank is artificially slowed
+    by the chaos ``StallAt`` injector emits ``train/straggler`` events
+    (self-baseline mode on the single-process topology) and a
+    ``train/skew_ratio`` gauge above the factor."""
+    tele, trainer = _tiny_fit_with_stalls(
+        tmp_path, stall_steps=(9, 10, 11), stall_s=0.6
+    )
+    evs = [e for e in tele.recent_events(200)
+           if e.get("name") == "train/straggler"]
+    assert evs, "stalled run emitted no train/straggler event"
+    det = evs[-1]
+    assert det["mode"] == "self" and det["rank"] == 0
+    assert det["ratio"] > 2.5
+    assert tele.registry.counter("train/stragglers").value >= 1
+    assert tele.registry.gauge("train/step_ewma_s").value > 0
+    # the event also landed in the JSONL log (the analyzer's input)
+    recs = [json.loads(line) for line in
+            (tmp_path / "events-rank0.jsonl").read_text().splitlines()]
+    assert any(r.get("name") == "train/straggler" for r in recs)
+    # ... whose first line is the meta record the analyzer aligns on
+    assert recs[0]["kind"] == "meta"
+    # and the analyzer can read its own dog food
+    report = A.skew_report(A.load_dir(str(tmp_path)))
+    assert report["steps"] >= 12
+
+
+def test_live_healthy_run_stays_quiet(tmp_path, cpu_runtime):
+    tele, trainer = _tiny_fit_with_stalls(tmp_path, stall_steps=(), stall_s=0)
+    assert not [e for e in tele.recent_events(200)
+                if e.get("name") == "train/straggler"]
+    assert tele.registry.counter("train/stragglers").value == 0
+
+
+# -- JSONL rotation (write side lives in telemetry; read side here) -----------
+
+
+class TestRotation:
+    def test_rotation_caps_size_and_keeps_k_segments(self, tmp_path):
+        path = str(tmp_path / "events-rank0.jsonl")
+        tele = T.Telemetry(path, rank=0, max_bytes=1200, keep_segments=2)
+        for i in range(60):
+            tele.event("tick", i=i)
+        tele.close()
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")  # keep-K enforced
+        for seg in (path + ".1", path + ".2"):
+            assert os.path.getsize(seg) <= 1200 + 600  # cap + one record
+            first = json.loads(open(seg).readline())
+            assert first["kind"] == "meta"  # each segment self-aligns
+
+    def test_analyzer_reads_rotated_run_in_order_without_dupes(self, tmp_path):
+        path = str(tmp_path / "events-rank0.jsonl")
+        tele = T.Telemetry(path, rank=0, max_bytes=1500, keep_segments=4)
+        n = 40
+        for i in range(n):
+            tele.event("tick", i=i)
+        tele.close()
+        rl = A.load_rank(path)
+        ticks = [e["i"] for e in rl.events if e["name"] == "tick"]
+        # keep=4 retains everything here; order is oldest-first, no dupes
+        assert ticks == list(range(n))
+        assert rl.meta is not None
+
+    def test_oldest_segments_are_dropped_beyond_keep(self, tmp_path):
+        path = str(tmp_path / "events-rank0.jsonl")
+        tele = T.Telemetry(path, rank=0, max_bytes=600, keep_segments=1)
+        for i in range(80):
+            tele.event("tick", i=i)
+        tele.close()
+        rl = A.load_rank(path)
+        ticks = [e["i"] for e in rl.events if e["name"] == "tick"]
+        assert ticks == sorted(ticks)
+        assert ticks[-1] == 79  # the newest survived
+        assert ticks[0] > 0  # the oldest were rotated away
+
+    def test_keep_zero_retains_no_history(self, tmp_path):
+        path = str(tmp_path / "events-rank0.jsonl")
+        tele = T.Telemetry(path, rank=0, max_bytes=600, keep_segments=0)
+        for i in range(80):
+            tele.event("tick", i=i)
+        tele.close()
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".1")  # rotation just truncates
+        rl = A.load_rank(path)
+        ticks = [e["i"] for e in rl.events if e["name"] == "tick"]
+        assert ticks and ticks[-1] == 79
+
+    def test_no_rotation_by_default(self, tmp_path):
+        path = str(tmp_path / "events-rank0.jsonl")
+        tele = T.Telemetry(path, rank=0)
+        for i in range(50):
+            tele.event("tick", i=i)
+        tele.close()
+        assert not os.path.exists(path + ".1")
+
+
+# -- system metrics -> registry gauges (satellite) ----------------------------
+
+
+def test_system_metrics_mirror_into_registry_gauges():
+    from tpuframe.track.system_metrics import SystemMetricsMonitor
+
+    reg = T.MetricsRegistry()
+    mon = SystemMetricsMonitor(run=None, registry=reg)  # registry-only mode
+    metrics = mon.sample()
+    assert "system/cpu_utilization" in metrics
+    snap = reg.snapshot()
+    assert snap["system/cpu_util"] >= 0
+    assert snap["system/rss_mb"] > 0
+    # ... which is exactly what the Prometheus endpoint serves
+    assert "tpuframe_system_rss_mb" in reg.prometheus_text()

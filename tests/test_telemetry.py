@@ -173,13 +173,18 @@ class TestJsonl:
         path = tmp_path / "events-rank2.jsonl"
         assert tele.jsonl_path == str(path)
         recs = [json.loads(line) for line in path.read_text().splitlines()]
-        assert len(recs) == 2
+        assert len(recs) == 3
         for rec in recs:  # the envelope every record carries
-            for key in ("v", "ts", "rank", "pid", "thread", "kind", "name"):
+            for key in ("v", "ts", "mono", "rank", "pid", "thread", "kind",
+                        "name"):
                 assert key in rec, key
             assert rec["v"] == T.SCHEMA_VERSION
             assert rec["rank"] == 2
-        span, ev = recs
+        meta, span, ev = recs
+        # first line of every sink-backed log: the clock-anchor meta record
+        assert meta["kind"] == "meta" and meta["schema"] == T.SCHEMA_VERSION
+        assert meta["anchor_wall"] > 0 and meta["anchor_mono"] >= 0
+        assert "hostname" in meta
         assert span["kind"] == "span" and span["name"] == "a"
         assert span["dur_s"] >= 0 and span["ok"] is True
         assert span["stack"] == ["a"] and span["attrs"] == {"note": "hi"}

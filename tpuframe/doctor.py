@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import shlex
 import shutil
 import os
 import subprocess
@@ -80,6 +81,12 @@ def _probe_devices(timeout_s: float) -> dict:
         return {"error": f"unparseable probe output: {proc.stdout[-200:]}"}
 
 
+def _telemetry_env_vars() -> tuple[str, ...]:
+    from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
+
+    return OBSERVABILITY_ENV_VARS
+
+
 def telemetry_section() -> dict:
     """State of the telemetry spine (`tpuframe.track.telemetry`): where the
     event log goes, whether a stall watchdog is armed, which exporters are
@@ -94,6 +101,16 @@ def telemetry_section() -> dict:
         exporters.append("jsonl")
     return {
         "event_log": tele.jsonl_path,
+        # the fleet-analysis one-liner for THIS run's telemetry dir —
+        # paste-ready next to the bug report (track/analyze.py), so it
+        # must survive pasting: quote the dir, '.' when path-less
+        "analyze": (
+            "python -m tpuframe.track analyze "
+            f"{shlex.quote(os.path.dirname(tele.jsonl_path) or '.')} --report"
+            if tele.jsonl_path else
+            "set TPUFRAME_TELEMETRY_DIR, then: "
+            "python -m tpuframe.track analyze <dir> --report"
+        ),
         "events_buffered": len(tele.recent_events(10**9)),
         "exporters": exporters,
         "watchdog": {
@@ -104,8 +121,7 @@ def telemetry_section() -> dict:
         },
         "env": {
             k: os.environ[k]
-            for k in ("TPUFRAME_TELEMETRY_DIR", "TPUFRAME_WATCHDOG_S",
-                      "TPUFRAME_WATCHDOG_DEADLINES")
+            for k in _telemetry_env_vars()
             if k in os.environ
         },
     }

@@ -371,29 +371,12 @@ def agree(local_flag: bool) -> bool:
 
     Called at the same step boundary on every host (the train loop is
     synchronous), so all hosts get the same verdict at the same step and
-    the last-chance checkpoint lands on one agreed step.  A process that
-    never imported jax is by definition not part of a multi-host jax
-    runtime, so it gets the local flag back without jax being imported
-    (or its backend initialized) here; with jax already live,
-    ``process_count() == 1`` likewise short-circuits to the local flag.
+    the last-chance checkpoint lands on one agreed step.  The gather and
+    its degradation ladder (no jax imported / single process /
+    multi-process-CPU test topology -> local-only) live in
+    :func:`tpuframe.track.analyze.fleet_allgather`, shared with the
+    straggler collective so the two can never diverge on the same fleet.
     """
-    import sys
+    from tpuframe.track.analyze import fleet_allgather
 
-    jax = sys.modules.get("jax")
-    if jax is None:
-        return bool(local_flag)
-    if jax.process_count() == 1:
-        return bool(local_flag)
-    if jax.default_backend() == "cpu":
-        # XLA's CPU backend cannot run multiprocess computations, and
-        # multi-process-over-CPU is a test topology (real pods are
-        # TPU/GPU): degrade to local-only agreement rather than crash
-        # the loop it is guarding
-        return bool(local_flag)
-    import numpy as np
-    from jax.experimental import multihost_utils
-
-    flags = multihost_utils.process_allgather(
-        np.asarray([local_flag], dtype=np.int32)
-    )
-    return bool(np.asarray(flags).any())
+    return any(v != 0.0 for v in fleet_allgather(float(bool(local_flag))))

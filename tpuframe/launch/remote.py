@@ -221,6 +221,17 @@ class RemoteDistributor:
                     token: str, hb_port: int | None) -> dict[str, str]:
         world = len(self.hosts)
         env = dict(self.extra_env)
+        # Driver-side observability/fault knobs ship to every host by
+        # default (explicit ``env=`` entries win).  The local Distributor
+        # inherits the whole driver environ; remote hosts start from the
+        # stdin header alone, and a fleet whose ranks silently ran
+        # without telemetry cannot be skew-analyzed after the fact
+        # (``python -m tpuframe.track analyze`` needs every rank's log).
+        from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
+
+        for var in OBSERVABILITY_ENV_VARS:
+            if var in os.environ and var not in env:
+                env[var] = os.environ[var]
         env.update(
             MASTER_ADDR=master,
             MASTER_PORT=str(port),

@@ -24,6 +24,12 @@ import importlib
 
 # name -> submodule it lives in (all under tpuframe.track)
 _EXPORTS = {
+    "RankLog": "analyze",
+    "StragglerMonitor": "analyze",
+    "baseline_diff": "analyze",
+    "build_trace": "analyze",
+    "load_trace_dir": "analyze",
+    "skew_report": "analyze",
     "ExperimentTracker": "mlflow_store",
     "MLflowLogger": "mlflow_store",
     "Run": "mlflow_store",
@@ -55,9 +61,10 @@ _EXPORTS = {
 }
 
 # a few exports carry a different name in their home module
-_ALIASES = {"configure_telemetry": "configure"}
+_ALIASES = {"configure_telemetry": "configure", "load_trace_dir": "load_dir"}
 
 _SUBMODULES = (
+    "analyze",
     "http_store",
     "mlflow_store",
     "profiler",

@@ -1,0 +1,34 @@
+"""``python -m tpuframe.track`` — observability CLI.
+
+Subcommands:
+
+    analyze <dir> [--trace out.json] [--report] [--baseline results/]
+        Merge a TPUFRAME_TELEMETRY_DIR of per-rank events-rank*.jsonl
+        logs into a Perfetto-loadable trace and a cross-rank skew
+        report (tpuframe.track.analyze).
+
+Stdlib-only: analyzing a wedged fleet's logs must not need jax.
+"""
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "analyze":
+        from tpuframe.track.analyze import main as analyze_main
+
+        return analyze_main(argv[1:])
+    prog = "python -m tpuframe.track"
+    if argv and argv[0] not in ("-h", "--help"):
+        print(f"{prog}: unknown command {argv[0]!r}", file=sys.stderr)
+    print(
+        f"usage: {prog} analyze <telemetry-dir> "
+        "[--trace out.json] [--report] [--baseline results/] [--json]",
+        file=sys.stderr,
+    )
+    return 0 if argv and argv[0] in ("-h", "--help") else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,866 @@
+"""Fleet-level trace analysis: cross-rank JSONL merge, skew, stragglers.
+
+A multi-host tpuframe job is only as fast as its slowest rank, and the
+telemetry spine (`track/telemetry.py`) already gives every rank an
+attributed ``events-rank<N>.jsonl`` log — but nothing read those logs
+*together*.  This module is the fleet layer on top of the spine, the
+capability the reference repo delegates to Ray's dashboard and MLflow
+system metrics (SURVEY.md §5) and profiling-driven TPU work treats as
+table stakes:
+
+- :func:`load_dir` merges a ``TPUFRAME_TELEMETRY_DIR`` of per-rank logs
+  (rotated segments included, oldest-first) and aligns ranks on the
+  wall/monotonic **anchor pair** from each log's ``meta`` first line —
+  a rank whose wall clock steps mid-run (NTP) still lands on the shared
+  timeline, because placement uses its steady monotonic clock.
+- :func:`build_trace` renders the merged fleet as a Chrome/Perfetto
+  ``trace.json``: one process track per rank (named ``rank N @ host``),
+  one thread track per instrumented thread, spans as complete events,
+  stalls/faults/stragglers as instant events.
+- :func:`skew_report` builds the per-step cross-rank skew table: for
+  each ``train/step`` batch index, min/median/max wall time, the
+  slowest rank, time lost to the straggler, and an input-bound vs
+  compute-bound vs checkpoint-bound classification derived from the
+  ``train/step`` span (+ its ``data_wait_s`` attribute) and ``ckpt/*``
+  spans.
+- :func:`baseline_diff` compares the run's step-time distribution
+  against committed ``benchmarks/results/*.json`` records (any record
+  carrying a ``step_time`` block, e.g. ``analyze_selftest_cpu.json``).
+- :class:`StragglerMonitor` is the *live* counterpart, wired into the
+  Trainer: each rank keeps a rolling step-time EWMA in the registry
+  (``train/step_ewma_s``), and every ``sync_steps`` steps the fleet
+  compares EWMAs through a tiny ``agree()``-style all-gather (same
+  degradation ladder as ``fault/preempt.py``).  A rank exceeding the
+  fleet median by ``factor`` emits a ``train/straggler`` event and the
+  ``train/skew_ratio`` gauge.  Single-process topologies degrade to a
+  self-baseline: the current EWMA against the rank's own median step
+  time, which still catches a rank *going* slow (thermal throttle, a
+  dying disk feeding the loader).
+
+CLI: ``python -m tpuframe.track analyze <dir> [--trace out.json]
+[--report] [--baseline results/]`` — stdlib-only, never imports jax
+(analyzing a wedged fleet's logs must not require a working backend).
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Sequence
+
+from tpuframe.track.telemetry import Histogram, get_telemetry
+
+__all__ = [
+    "RankLog",
+    "StragglerMonitor",
+    "baseline_diff",
+    "build_trace",
+    "fleet_allgather",
+    "format_report",
+    "load_dir",
+    "load_rank",
+    "main",
+    "skew_report",
+]
+
+_RANK_RE = re.compile(r"events-rank(\d+)\.jsonl$")
+
+#: envelope keys every record carries; everything else is event payload
+_ENVELOPE = ("v", "ts", "mono", "rank", "pid", "thread", "kind", "name")
+
+#: span names that mark checkpoint I/O for boundedness classification
+_CKPT_SPANS = ("ckpt/save", "ckpt/restore", "fault/preempt_checkpoint")
+
+
+# -- loading + clock alignment ------------------------------------------------
+
+
+class RankLog:
+    """One rank's merged event stream + its clock-alignment offsets.
+
+    ``meta`` is the log's first ``meta`` record (or None for pre-meta
+    logs).  With a meta anchor pair, :meth:`end_time` places a record at
+    ``mono + (anchor_wall - anchor_mono)`` — the rank's steady monotonic
+    clock mapped onto the wall timeline fixed at configure time, immune
+    to mid-run wall-clock steps.  Anchors are kept **per pid**: a
+    restarted process appending to the same log brings a fresh monotonic
+    epoch (near zero after a host reboot), so its events must align with
+    *its own* meta, not the dead predecessor's.  Records with no usable
+    anchor fall back to their raw ``ts``.
+    """
+
+    def __init__(self, rank: int, events: list[dict], *,
+                 meta: dict | None = None, path: str | None = None,
+                 metas: Sequence[dict] = ()):
+        self.rank = rank
+        self.events = events
+        self.meta = meta
+        self.path = path
+        # pid -> (anchor_wall - anchor_mono); the newest meta per pid
+        # wins (a re-configure within one process is a re-calibration)
+        self.pid_offsets: dict[Any, float] = {}
+        for m in list(metas) or ([meta] if meta else []):
+            aw, am = m.get("anchor_wall"), m.get("anchor_mono")
+            if aw is not None and am is not None:
+                self.pid_offsets[m.get("pid")] = float(aw) - float(am)
+        self.mono_offset: float | None = None
+        if meta is not None:
+            aw, am = meta.get("anchor_wall"), meta.get("anchor_mono")
+            if aw is not None and am is not None:
+                self.mono_offset = float(aw) - float(am)
+
+    @property
+    def hostname(self) -> str:
+        return (self.meta or {}).get("hostname", "") or ""
+
+    def end_time(self, rec: dict) -> float:
+        """Fleet-aligned wall-clock time a record was written at."""
+        mono = rec.get("mono")
+        offset = self.pid_offsets.get(rec.get("pid"), self.mono_offset)
+        if mono is not None and offset is not None:
+            return float(mono) + offset
+        return float(rec.get("ts", 0.0))
+
+    def __repr__(self):
+        return (f"RankLog(rank={self.rank}, events={len(self.events)}, "
+                f"host={self.hostname!r})")
+
+
+def _segments(base: str) -> list[str]:
+    """A log's files oldest-first: ``base.K`` .. ``base.1``, then ``base``
+    (the rotation order `telemetry.Telemetry._rotate_locked` produces)."""
+    suffixes = []
+    for p in glob.glob(base + ".*"):
+        suf = p[len(base) + 1:]
+        if suf.isdigit():
+            suffixes.append(int(suf))
+    return [f"{base}.{n}" for n in sorted(suffixes, reverse=True)] + [base]
+
+
+def load_rank(base: str) -> RankLog:
+    """Parse one rank's log (rotated segments in order).  Torn trailing
+    lines (a crash mid-write) and blank lines are skipped, not fatal —
+    the analyzer's whole job is reading logs of runs that died."""
+    events: list[dict] = []
+    metas: list[dict] = []
+    for path in _segments(base):
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn line
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("kind") == "meta":
+                    # every meta kept: a restarted process appended its
+                    # own anchors, and RankLog aligns per pid
+                    metas.append(rec)
+                else:
+                    events.append(rec)
+    meta = metas[0] if metas else None
+    m = _RANK_RE.search(base)
+    if m:
+        rank = int(m.group(1))
+    elif meta is not None:
+        rank = int(meta.get("rank", 0))
+    else:
+        rank = int(events[0].get("rank", 0)) if events else 0
+    return RankLog(rank, events, meta=meta, path=base, metas=metas)
+
+
+def load_dir(d: str) -> list[RankLog]:
+    """All ranks under a telemetry dir, rank-ordered."""
+    bases = sorted(
+        p for p in glob.glob(os.path.join(d, "events-rank*.jsonl"))
+        if _RANK_RE.search(p)
+    )
+    if not bases:
+        raise FileNotFoundError(
+            f"no events-rank*.jsonl under {d!r} — is this a "
+            "TPUFRAME_TELEMETRY_DIR?"
+        )
+    ranks = [load_rank(b) for b in bases]
+    ranks.sort(key=lambda r: r.rank)
+    return ranks
+
+
+# -- Perfetto / Chrome trace --------------------------------------------------
+
+
+def _fleet_t0(ranks: Sequence[RankLog]) -> float:
+    """Earliest aligned instant across the fleet (span starts included)."""
+    t0 = None
+    for rl in ranks:
+        for rec in rl.events:
+            t = rl.end_time(rec)
+            if rec.get("kind") == "span":
+                t -= float(rec.get("dur_s", 0.0))
+            if t0 is None or t < t0:
+                t0 = t
+    return t0 or 0.0
+
+
+def _clip(v: Any, cap: int = 400) -> Any:
+    return v[:cap] if isinstance(v, str) and len(v) > cap else v
+
+
+def build_trace(ranks: Sequence[RankLog]) -> dict:
+    """Chrome Trace Event JSON (Perfetto/chrome://tracing loadable).
+
+    One ``pid`` per rank, one ``tid`` per thread; spans become complete
+    ("X") events at microsecond resolution, everything else becomes an
+    instant ("i") event — stalls, faults, stragglers, bench attempts.
+    """
+    t0 = _fleet_t0(ranks)
+    out: list[dict] = []
+    for rl in ranks:
+        pid = rl.rank
+        label = f"rank {rl.rank}" + (f" @ {rl.hostname}" if rl.hostname else "")
+        out.append({"ph": "M", "pid": pid, "name": "process_name",
+                    "args": {"name": label}})
+        out.append({"ph": "M", "pid": pid, "name": "process_sort_index",
+                    "args": {"sort_index": rl.rank}})
+        tids: dict[str, int] = {}
+
+        def tid_for(thread: str) -> int:
+            if thread not in tids:
+                # MainThread pinned to tid 0; helpers in appearance order
+                tids[thread] = 0 if thread == "MainThread" else len(tids) + 1
+            return tids[thread]
+
+        for rec in rl.events:
+            t_end = rl.end_time(rec)
+            tid = tid_for(str(rec.get("thread", "?")))
+            name = str(rec.get("name", "?"))
+            payload = {k: _clip(v) for k, v in rec.items()
+                       if k not in _ENVELOPE and k != "attrs"}
+            payload.update(
+                {k: _clip(v) for k, v in (rec.get("attrs") or {}).items()}
+            )
+            if rec.get("kind") == "span":
+                dur = float(rec.get("dur_s", 0.0))
+                ev = {
+                    "ph": "X", "pid": pid, "tid": tid, "name": name,
+                    "cat": name.split("/")[0],
+                    "ts": round((t_end - dur - t0) * 1e6, 1),
+                    "dur": round(dur * 1e6, 1),
+                    "args": {k: v for k, v in payload.items()
+                             if k not in ("dur_s", "stack", "ok")},
+                }
+                if not rec.get("ok", True):
+                    ev["cname"] = "terrible"  # failed spans read red
+            else:
+                ev = {
+                    "ph": "i", "pid": pid, "tid": tid, "name": name,
+                    "cat": str(rec.get("kind", "event")),
+                    "ts": round((t_end - t0) * 1e6, 1),
+                    "s": "t",  # thread-scoped flag
+                    "args": payload,
+                }
+            out.append(ev)
+        for thread, tid in tids.items():
+            out.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name", "args": {"name": thread}})
+            out.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_sort_index",
+                        "args": {"sort_index": tid}})
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "tpuframe.track.analyze",
+            "ranks": len(ranks),
+            "t0_unix_s": round(t0, 6),
+        },
+    }
+
+
+# -- cross-rank skew ----------------------------------------------------------
+
+
+# ONE quantile convention repo-wide: whatever the registry histograms
+# report on /metrics is what baseline_diff ratios against — a fix to the
+# index rule must land in telemetry.Histogram and flow here
+_pctl = Histogram._quantile
+
+
+def _step_rows(rl: RankLog) -> dict[int, dict]:
+    """This rank's ``train/step`` spans keyed by batch index, with the
+    inter-step period (``wall_s``) that captures everything between step
+    boundaries — data wait, dispatch, mid-epoch checkpoints, GC pauses,
+    callbacks.  However large: a 10 s checkpoint stall between 0.1 s
+    steps is exactly what the skew report exists to surface, so the
+    period is only rejected on *structural* grounds — a different pid
+    (restart appended to the same log) or an epoch boundary in between
+    (eval/epoch turnover time is not one step's cost) — never because
+    it is "too big"."""
+    epoch_ends = sorted(
+        rl.end_time(rec) for rec in rl.events
+        if rec.get("kind") == "span" and rec.get("name") == "train/epoch"
+    )
+
+    def crosses_epoch_boundary(a: float, b: float) -> bool:
+        i = bisect.bisect_right(epoch_ends, a)
+        return i < len(epoch_ends) and epoch_ends[i] < b
+
+    rows: dict[int, dict] = {}
+    prev_end: float | None = None
+    prev_batch: int | None = None
+    prev_pid: Any = None
+    for rec in rl.events:
+        if rec.get("kind") != "span" or rec.get("name") != "train/step":
+            continue
+        attrs = rec.get("attrs") or {}
+        batch = attrs.get("batch")
+        if batch is None:
+            continue
+        batch = int(batch)
+        end = rl.end_time(rec)
+        dur = float(rec.get("dur_s", 0.0))
+        wait = float(attrs.get("data_wait_s", 0.0))
+        wall = dur + wait
+        if (
+            prev_end is not None
+            and prev_batch == batch - 1
+            and rec.get("pid") == prev_pid
+            and not crosses_epoch_boundary(prev_end, end)
+        ):
+            period = end - prev_end
+            if period >= wall:
+                wall = period
+        rows[batch] = {"dur_s": dur, "data_wait_s": wait, "end": end,
+                       "wall_s": wall}
+        prev_end, prev_batch = end, batch
+        prev_pid = rec.get("pid")
+    return rows
+
+
+def _ckpt_windows(rl: RankLog) -> list[tuple[float, float]]:
+    wins = []
+    for rec in rl.events:
+        if rec.get("kind") == "span" and rec.get("name") in _CKPT_SPANS:
+            end = rl.end_time(rec)
+            wins.append((end - float(rec.get("dur_s", 0.0)), end))
+    return wins
+
+
+def _classify(entry: dict, ckpt_wins: list[tuple[float, float]]) -> str:
+    """Why was the slowest rank's step slow?  Checkpoint overlap beats
+    input wait beats the compute default."""
+    start = entry["end"] - entry["wall_s"]
+    for a, b in ckpt_wins:
+        if b > start and a < entry["end"]:
+            return "checkpoint"
+    if entry["data_wait_s"] >= 0.5 * max(entry["wall_s"], 1e-12):
+        return "input"
+    return "compute"
+
+
+def skew_report(ranks: Sequence[RankLog], *,
+                straggler_factor: float = 1.5,
+                warmup_steps: int = 1) -> dict:
+    """The per-step cross-rank skew table + fleet aggregates.
+
+    For every ``train/step`` batch index: min/median/max per-rank wall
+    time, the slowest rank, ``lost_s`` (max - median: wall-clock the
+    fleet spent waiting on the straggler that step, under synchronous
+    data parallelism), and the boundedness class of the slowest rank.
+
+    The first ``warmup_steps`` batch indices are dropped, for the same
+    reason the live monitor's ``skip_first`` exists: on jax they carry
+    the JIT compile, whose cross-rank jitter would read as a spurious
+    compute straggler and whose hundreds-of-ms duration would pollute
+    the ``step_time`` distribution committed as a regression baseline.
+    """
+    per_rank_rows = {rl.rank: _step_rows(rl) for rl in ranks}
+    ckpt_wins = {rl.rank: _ckpt_windows(rl) for rl in ranks}
+    all_batches = sorted({b for rows in per_rank_rows.values() for b in rows})
+    all_batches = all_batches[max(0, int(warmup_steps)):]
+
+    per_step: list[dict] = []
+    excess: dict[int, float] = {rl.rank: 0.0 for rl in ranks}
+    slow_count: dict[int, int] = {rl.rank: 0 for rl in ranks}
+    lost_by_bound = {"input": 0.0, "compute": 0.0, "checkpoint": 0.0}
+    all_durs: list[float] = []
+    all_walls: list[float] = []
+
+    for b in all_batches:
+        walls = {r: rows[b]["wall_s"] for r, rows in per_rank_rows.items()
+                 if b in rows}
+        for r in walls:
+            all_durs.append(per_rank_rows[r][b]["dur_s"])
+            all_walls.append(walls[r])
+        slowest = max(walls, key=lambda r: walls[r])
+        med = statistics.median(walls.values())
+        lost = max(0.0, walls[slowest] - med)
+        bound = _classify(per_rank_rows[slowest][b], ckpt_wins[slowest])
+        row = {
+            "batch": b,
+            "n_ranks": len(walls),
+            "min_s": round(min(walls.values()), 6),
+            "median_s": round(med, 6),
+            "max_s": round(walls[slowest], 6),
+            "slowest_rank": slowest,
+            "lost_s": round(lost, 6),
+            "bound": bound,
+            "straggling": walls[slowest] > straggler_factor * max(med, 1e-12),
+        }
+        per_step.append(row)
+        excess[slowest] += lost
+        if row["straggling"]:
+            slow_count[slowest] += 1
+            lost_by_bound[bound] += lost
+
+    durs = sorted(all_durs)
+    walls = sorted(all_walls)
+    step_time = {}
+    if durs:
+        step_time = {
+            "count": len(durs),
+            "mean": round(sum(durs) / len(durs), 6),
+            "p50": round(_pctl(durs, 0.50), 6),
+            "p95": round(_pctl(durs, 0.95), 6),
+            "p99": round(_pctl(durs, 0.99), 6),
+        }
+    worst = max(excess, key=lambda r: excess[r]) if excess else None
+    return {
+        "ranks": len(ranks),
+        "hosts": sorted({rl.hostname for rl in ranks if rl.hostname}),
+        "steps": len(per_step),
+        "warmup_steps_skipped": max(0, int(warmup_steps)),
+        "straggler_factor": straggler_factor,
+        "step_time": step_time,          # dispatch-only (baseline diffs)
+        "step_wall": {                   # boundary-to-boundary
+            "p50": round(_pctl(walls, 0.50), 6) if walls else None,
+            "p95": round(_pctl(walls, 0.95), 6) if walls else None,
+        },
+        # total skew (max-median summed over EVERY step: jitter included)
+        # vs the straggler share (only over-factor steps — this is the
+        # number lost_by_bound decomposes, so the two always agree)
+        "total_lost_s": round(sum(r["lost_s"] for r in per_step), 6),
+        "straggler_lost_s": round(
+            sum(r["lost_s"] for r in per_step if r["straggling"]), 6),
+        "straggling_steps": sum(1 for r in per_step if r["straggling"]),
+        "lost_by_bound": {k: round(v, 6) for k, v in lost_by_bound.items()},
+        "slowest": None if worst is None else {
+            "rank": worst,
+            "excess_s": round(excess[worst], 6),
+            "times_slowest": slow_count[worst],
+        },
+        "per_rank": [
+            {
+                "rank": rl.rank,
+                "host": rl.hostname,
+                "steps": len(per_rank_rows[rl.rank]),
+                "excess_s": round(excess[rl.rank], 6),
+                "straggling_steps": slow_count[rl.rank],
+                "data_wait_total_s": round(
+                    sum(e["data_wait_s"]
+                        for e in per_rank_rows[rl.rank].values()), 6),
+            }
+            for rl in ranks
+        ],
+        "per_step": per_step,
+    }
+
+
+# -- baseline regression diff -------------------------------------------------
+
+
+def baseline_diff(report: dict, baseline: str, *,
+                  threshold: float = 1.25, backend: str | None = None) -> dict:
+    """Compare this run's step-time distribution against committed bench
+    records — any ``benchmarks/results/*.json`` file whose top-level
+    object carries a ``step_time`` block with ``p50`` (the
+    ``bench_analyze.py`` self-test commits one per backend).
+
+    ``ratio_p50 > threshold`` lands the pair in ``regressions``.
+    ``backend`` filters the baselines compared (``"cpu"``/``"tpu"``):
+    without it a CPU run diffed against a results dir that also holds
+    TPU records would read ~10x "slower" and trip the regression exit
+    code spuriously — pass the backend the run actually used (records
+    with no ``backend`` field are always compared).
+    """
+    if os.path.isfile(baseline):
+        paths = [baseline]
+    else:
+        paths = sorted(glob.glob(os.path.join(baseline, "*.json")))
+    cur = report.get("step_time") or {}
+    out: dict = {"threshold": threshold, "backend": backend,
+                 "baselines": [], "regressions": []}
+    for p in paths:
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        st = rec.get("step_time") if isinstance(rec, dict) else None
+        if not isinstance(st, dict) or not st.get("p50"):
+            continue
+        if backend and rec.get("backend") and rec["backend"] != backend:
+            continue
+        entry = {
+            "file": os.path.basename(p),
+            "backend": rec.get("backend"),
+            "baseline_p50_s": st["p50"],
+            "current_p50_s": cur.get("p50"),
+        }
+        for q in ("p50", "p95"):
+            if cur.get(q) and st.get(q):
+                entry[f"ratio_{q}"] = round(cur[q] / st[q], 4)
+        out["baselines"].append(entry)
+        if entry.get("ratio_p50") and entry["ratio_p50"] > threshold:
+            out["regressions"].append(entry)
+    return out
+
+
+# -- human-readable report ----------------------------------------------------
+
+
+def format_report(report: dict, diff: dict | None = None, *,
+                  max_rows: int = 20) -> str:
+    """The ``--report`` text: fleet summary, the worst skew rows, per-rank
+    attribution, optional baseline verdicts (runbook: OBSERVABILITY.md
+    "Reading a skew report")."""
+    lines = []
+    hosts = f" on {len(report['hosts'])} host(s)" if report.get("hosts") else ""
+    warm = report.get("warmup_steps_skipped", 0)
+    lines.append(
+        f"fleet skew report: {report['ranks']} rank(s){hosts}, "
+        f"{report['steps']} step(s)"
+        + (f" ({warm} warmup/compile step(s) skipped)" if warm else "")
+    )
+    st = report.get("step_time") or {}
+    if st:
+        lines.append(
+            f"  step time (dispatch): p50={st['p50'] * 1e3:.1f}ms "
+            f"p95={st['p95'] * 1e3:.1f}ms mean={st['mean'] * 1e3:.1f}ms "
+            f"over {st['count']} rank-steps"
+        )
+    lines.append(
+        f"  time lost to stragglers: {report['straggler_lost_s']:.3f}s "
+        f"across {report['straggling_steps']} straggling step(s) "
+        f"(factor > {report['straggler_factor']}); total cross-rank skew "
+        f"incl. jitter: {report['total_lost_s']:.3f}s"
+    )
+    lb = report["lost_by_bound"]
+    lines.append(
+        "  straggler time by cause: "
+        + "  ".join(f"{k}={v:.3f}s" for k, v in lb.items())
+    )
+    if report.get("slowest"):
+        s = report["slowest"]
+        lines.append(
+            f"  slowest rank: {s['rank']} (excess {s['excess_s']:.3f}s, "
+            f"slowest on {s['times_slowest']} straggling step(s))"
+        )
+    rows = report["per_step"]
+    shown = sorted(rows, key=lambda r: r["lost_s"], reverse=True)[:max_rows]
+    shown.sort(key=lambda r: r["batch"])
+    if len(rows) > len(shown):
+        lines.append(f"  -- worst {len(shown)} of {len(rows)} steps by lost_s --")
+    lines.append(
+        "  batch   min_s   med_s   max_s  slowest  lost_s  bound"
+    )
+    for r in shown:
+        flag = " *" if r["straggling"] else ""
+        lines.append(
+            f"  {r['batch']:>5} {r['min_s']:>7.3f} {r['median_s']:>7.3f} "
+            f"{r['max_s']:>7.3f}  rank {r['slowest_rank']:<3} "
+            f"{r['lost_s']:>6.3f}  {r['bound']}{flag}"
+        )
+    lines.append("  per-rank:")
+    for pr in report["per_rank"]:
+        host = f" @ {pr['host']}" if pr["host"] else ""
+        lines.append(
+            f"    rank {pr['rank']}{host}: {pr['steps']} steps, "
+            f"excess {pr['excess_s']:.3f}s, straggling "
+            f"{pr['straggling_steps']}, data_wait {pr['data_wait_total_s']:.3f}s"
+        )
+    if diff is not None:
+        lines.append(
+            f"  baseline diff (regression = ratio_p50 > {diff['threshold']}):"
+        )
+        if not diff["baselines"]:
+            lines.append("    no comparable step_time baselines found")
+        for b in diff["baselines"]:
+            verdict = (
+                "REGRESSION" if b in diff["regressions"] else "ok"
+            )
+            ratio = b.get("ratio_p50")
+            lines.append(
+                f"    vs {b['file']} [{b.get('backend')}]: "
+                f"p50 {b['baseline_p50_s'] * 1e3:.1f}ms -> "
+                f"{(b.get('current_p50_s') or 0) * 1e3:.1f}ms "
+                f"(x{ratio:.2f}) {verdict}" if ratio is not None else
+                f"    vs {b['file']}: incomparable"
+            )
+    return "\n".join(lines)
+
+
+# -- live straggler detection -------------------------------------------------
+
+
+def fleet_allgather(value: float) -> list[float]:
+    """All ranks' values, rank-ordered — THE tiny fleet collective, with
+    one degradation ladder shared by straggler detection and
+    :func:`tpuframe.fault.preempt.agree` (which delegates here): a
+    process that never imported jax is by definition not part of a
+    multi-host jax runtime (local-only, without importing jax or
+    initializing its backend); with jax live, single-process
+    short-circuits; and the multi-process-CPU test topology degrades to
+    local rather than crash the loop it is watching (XLA's CPU backend
+    cannot run multiprocess computations — real pods are TPU/GPU)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return [float(value)]
+    if jax.process_count() == 1 or jax.default_backend() == "cpu":
+        return [float(value)]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(
+        np.asarray([value], dtype=np.float64)
+    )
+    return [float(v) for v in np.asarray(vals).ravel()]
+
+
+class StragglerMonitor:
+    """Rolling step-time EWMA + periodic fleet comparison.
+
+    Call :meth:`mark` at a loop boundary (epoch start) and
+    :meth:`observe` after every step: with no explicit duration it
+    measures boundary-to-boundary wall time, which charges the straggler
+    whatever actually slowed it — input wait, dispatch, a checkpoint, a
+    GC pause, a chaos stall.
+
+    Every ``sync_steps`` observed steps (after ``min_steps`` warmup) the
+    fleet's EWMAs cross ranks through ``gather``:
+
+    - **fleet mode** (>1 rank): ``skew_ratio = max(ewma) / median(ewma)``;
+      when the worst rank exceeds ``factor``x the median, rank 0 emits
+      one ``train/straggler`` event naming it (rank-0 discipline — one
+      event per fleet verdict, in rank 0's log).
+    - **self mode** (gather degraded to this rank alone):
+      ``skew_ratio = ewma / median(own recent step times)`` — detects a
+      rank *becoming* slow against its own history; the event is emitted
+      locally.
+
+    Knobs default from the env so launch propagation is free:
+    ``TPUFRAME_STRAGGLER_STEPS`` (cadence, 0 disables, default 32) and
+    ``TPUFRAME_STRAGGLER_FACTOR`` (default 2.0).  The first observed
+    interval after construction is discarded (``skip_first``) — on jax
+    it is the compile step, and an 800x compile outlier would poison the
+    EWMA for the whole warmup window.
+    """
+
+    def __init__(
+        self,
+        *,
+        factor: float | None = None,
+        sync_steps: int | None = None,
+        alpha: float = 0.25,
+        min_steps: int = 8,
+        skip_first: int = 1,
+        baseline_window: int = 512,
+        gather: Callable[[float], Iterable[float]] | None = None,
+        rank: int | None = None,
+        telemetry: Any = None,
+    ):
+        if factor is None:
+            try:
+                factor = float(os.environ.get("TPUFRAME_STRAGGLER_FACTOR", 2.0))
+            except ValueError:
+                factor = 2.0
+        if sync_steps is None:
+            try:
+                sync_steps = int(os.environ.get("TPUFRAME_STRAGGLER_STEPS", 32))
+            except ValueError:
+                sync_steps = 32
+        self.factor = float(factor)
+        self.sync_steps = int(sync_steps)
+        self.alpha = float(alpha)
+        self.min_steps = int(min_steps)
+        self.skip_first = int(skip_first)
+        self._gather = gather or fleet_allgather
+        self._telemetry = telemetry
+        self._rank = rank
+        self._times: deque[float] = deque(maxlen=baseline_window)
+        self._t_last: float | None = None
+        self._skipped = 0
+        self.ewma: float | None = None
+        self.steps = 0
+        self.last: dict | None = None  # most recent detection
+
+    @property
+    def enabled(self) -> bool:
+        return self.sync_steps > 0 and self.factor > 0
+
+    def _tele(self):
+        return self._telemetry if self._telemetry is not None else get_telemetry()
+
+    @property
+    def rank(self) -> int:
+        return self._tele().rank if self._rank is None else self._rank
+
+    def mark(self) -> None:
+        """Reset the interval boundary (epoch start: the gap spanning
+        eval/checkpoint/epoch turnover must not read as a slow step)."""
+        self._t_last = time.monotonic()
+
+    def observe(self, step_s: float | None = None) -> dict | None:
+        """Record one step; returns the detection dict when this call's
+        fleet check fired, else None."""
+        now = time.monotonic()
+        if step_s is None:
+            if self._t_last is None:
+                self._t_last = now
+                return None
+            step_s = now - self._t_last
+        self._t_last = now
+        if self._skipped < self.skip_first:
+            self._skipped += 1
+            return None
+        self.steps += 1
+        self._times.append(float(step_s))
+        self.ewma = (
+            float(step_s) if self.ewma is None
+            else self.alpha * float(step_s) + (1 - self.alpha) * self.ewma
+        )
+        tele = self._tele()
+        tele.registry.gauge("train/step_ewma_s").set(self.ewma)
+        if (
+            not self.enabled
+            or self.steps < self.min_steps
+            or self.steps % self.sync_steps
+        ):
+            return None
+        return self._check(tele)
+
+    def _check(self, tele) -> dict | None:
+        fleet = [float(v) for v in self._gather(self.ewma)]
+        if len(fleet) > 1:
+            med = statistics.median(fleet)
+            worst = max(range(len(fleet)), key=fleet.__getitem__)
+            worst_ewma = fleet[worst]
+            mode = "fleet"
+        else:
+            med = statistics.median(self._times)
+            worst = self.rank
+            worst_ewma = self.ewma
+            mode = "self"
+        ratio = worst_ewma / max(med, 1e-12)
+        tele.registry.gauge("train/skew_ratio").set(ratio)
+        if ratio <= self.factor:
+            self.last = None
+            return None
+        det = {
+            "rank": worst,
+            "ewma_s": round(worst_ewma, 6),
+            "median_s": round(med, 6),
+            "ratio": round(ratio, 4),
+            "mode": mode,
+            "step": self.steps,
+            "factor": self.factor,
+        }
+        self.last = det
+        # one event per fleet verdict: rank 0 speaks for the fleet; in
+        # self mode the verdict only exists on this rank, so it speaks
+        if mode == "self" or self.rank == 0:
+            tele.registry.counter("train/stragglers").inc()
+            tele.event("train/straggler", **det)
+        return det
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuframe.track analyze",
+        description=(
+            "Fleet-level telemetry analysis: merge a dir of per-rank "
+            "events-rank*.jsonl logs into a Perfetto timeline and a "
+            "cross-rank skew report."
+        ),
+    )
+    ap.add_argument("dir", help="TPUFRAME_TELEMETRY_DIR of a finished run")
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="write a Chrome/Perfetto trace.json here")
+    ap.add_argument("--report", action="store_true",
+                    help="print the human-readable skew report")
+    ap.add_argument("--baseline", metavar="DIR_OR_FILE",
+                    help="diff step times vs committed bench records "
+                         "(e.g. benchmarks/results/)")
+    ap.add_argument("--baseline-backend", metavar="BACKEND",
+                    help="only diff against baselines recorded on this "
+                         "backend (cpu/tpu) — a CPU run vs a TPU record "
+                         "is not a regression")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report (+diff) as JSON instead")
+    ap.add_argument("--straggler-factor", type=float, default=1.5,
+                    help="a step straggles when max > FACTOR * median "
+                         "(default 1.5)")
+    ap.add_argument("--warmup-steps", type=int, default=1,
+                    help="drop the first N batch indices (compile; "
+                         "default 1)")
+    ap.add_argument("--regression-threshold", type=float, default=1.25,
+                    help="baseline diff flags ratio_p50 above this "
+                         "(default 1.25)")
+    args = ap.parse_args(argv)
+
+    try:
+        ranks = load_dir(args.dir)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    report = skew_report(ranks, straggler_factor=args.straggler_factor,
+                         warmup_steps=args.warmup_steps)
+    diff = None
+    if args.baseline:
+        diff = baseline_diff(report, args.baseline,
+                             threshold=args.regression_threshold,
+                             backend=args.baseline_backend)
+    # regressions are an actionable exit code for CI rungs — decided
+    # BEFORE printing, so `... | head` closing the pipe mid-report
+    # cannot swallow the verdict
+    rc = 3 if diff and diff["regressions"] else 0
+    try:
+        if args.trace:
+            trace = build_trace(ranks)
+            with open(args.trace, "w") as f:
+                json.dump(trace, f)
+            print(
+                f"wrote {args.trace}: {len(trace['traceEvents'])} events, "
+                f"{report['ranks']} rank track(s) — load in ui.perfetto.dev "
+                "or chrome://tracing"
+            )
+        if args.json:
+            print(json.dumps({"report": report, "diff": diff}, indent=2))
+        elif args.report or not args.trace:
+            print(format_report(report, diff))
+    except BrokenPipeError:
+        # normal CLI usage, not an error; silence the interpreter's
+        # close-time complaint about the dead stdout
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via track.__main__
+    raise SystemExit(main())

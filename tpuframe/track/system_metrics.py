@@ -6,6 +6,12 @@ and its ``nvidia-smi`` notebook cells (SURVEY.md §5 "Tracing / profiling"):
 a daemon thread samples /proc (CPU, RSS) and jax device memory stats (TPU HBM
 in-use) and appends them to the run's metrics with a monotonically increasing
 step, no external agents.
+
+Every sample is also mirrored into the telemetry registry as gauges
+(``system/cpu_util``, ``system/rss_mb``, ``system/device<i>_mem_used_mb``,
+``system/device<i>_mem_util``), so the Prometheus ``/metrics`` endpoint
+(``telemetry.start_metrics_server``) exposes host and HBM utilization —
+not just the Run logger path.  ``run=None`` runs the monitor registry-only.
 """
 
 from __future__ import annotations
@@ -52,16 +58,32 @@ def device_memory_stats() -> dict[str, float]:
 
 
 class SystemMetricsMonitor:
-    """Daemon thread logging system metrics to a Run every ``interval_s``."""
+    """Daemon thread logging system metrics every ``interval_s``.
 
-    def __init__(self, run, interval_s: float = 10.0, prefix: str = "system/"):
+    Args:
+      run: a tracker Run with ``log_metrics(dict, step=)``; None samples
+        into the telemetry registry only (the Prometheus path).
+      registry: MetricsRegistry to mirror gauges into (default: the
+        process-wide telemetry's).
+    """
+
+    def __init__(self, run=None, interval_s: float = 10.0,
+                 prefix: str = "system/", registry=None):
         self.run = run
         self.interval_s = interval_s
         self.prefix = prefix
+        self.registry = registry
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._step = 0
         self._lock = threading.Lock()  # serializes thread vs stop() final sample
+
+    def _registry(self):
+        if self.registry is not None:
+            return self.registry
+        from tpuframe.track.telemetry import get_telemetry
+
+        return get_telemetry().registry
 
     def sample(self) -> dict[str, float]:
         cpu, wall = _cpu_times()
@@ -70,19 +92,35 @@ class SystemMetricsMonitor:
         dcpu = cpu - self._last[0]
         dwall = max(wall - self._last[1], 1e-9)
         self._last = (cpu, wall)
+        cpu_util = min(dcpu / dwall, float(os.cpu_count() or 1))
+        rss = _rss_mb()
         metrics = {
-            f"{self.prefix}cpu_utilization": min(dcpu / dwall, float(os.cpu_count() or 1)),
-            f"{self.prefix}memory_rss_mb": _rss_mb(),
+            f"{self.prefix}cpu_utilization": cpu_util,
+            f"{self.prefix}memory_rss_mb": rss,
         }
-        for k, v in device_memory_stats().items():
+        devices = device_memory_stats()
+        for k, v in devices.items():
             metrics[f"{self.prefix}{k}"] = v
+        # registry mirror: the gauge names are fixed (OBSERVABILITY.md),
+        # independent of the Run-path prefix, so dashboards scraping
+        # /metrics see the same series whatever the run is called
+        reg = self._registry()
+        reg.gauge("system/cpu_util").set(cpu_util)
+        reg.gauge("system/rss_mb").set(rss)
+        for k, v in devices.items():
+            reg.gauge(f"system/{k}").set(v)
         return metrics
+
+    def _publish(self) -> None:
+        with self._lock:
+            metrics = self.sample()
+            if self.run is not None:
+                self.run.log_metrics(metrics, step=self._step)
+            self._step += 1
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            with self._lock:
-                self.run.log_metrics(self.sample(), step=self._step)
-                self._step += 1
+            self._publish()
 
     def start(self) -> None:
         if self._thread is None:
@@ -95,6 +133,4 @@ class SystemMetricsMonitor:
             self._thread.join(timeout=2.0)
             self._thread = None
         # final sample so short runs record at least one point
-        with self._lock:
-            self.run.log_metrics(self.sample(), step=self._step)
-            self._step += 1
+        self._publish()

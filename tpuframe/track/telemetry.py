@@ -36,10 +36,20 @@ instrumented hot paths cost two ``perf_counter`` calls and a dict update.
 Env knobs::
 
     TPUFRAME_TELEMETRY_DIR       write events-rank<N>.jsonl under this dir
+    TPUFRAME_TELEMETRY_MAX_MB    rotate the event log at this size (MB);
+                                 segments shift to .1 .. .K, oldest dropped
+    TPUFRAME_TELEMETRY_KEEP      rotated segments to keep (default 3;
+                                 0 = rotation keeps no history)
     TPUFRAME_WATCHDOG_S          attach a stall watchdog; default deadline
                                  (seconds) for every guarded activity
     TPUFRAME_WATCHDOG_DEADLINES  per-activity overrides, e.g.
                                  "train/step=120,ckpt/save=600"
+
+Every sink-backed log opens with a ``meta`` record (schema version, rank,
+hostname, pid, and a wall-clock/monotonic **anchor pair**) and every record
+carries both ``ts`` (wall) and ``mono`` (monotonic) timestamps — the fleet
+analyzer (``tpuframe.track.analyze``) uses the anchors to place every
+rank's events on one timeline even when a rank's wall clock steps mid-run.
 """
 
 from __future__ import annotations
@@ -47,6 +57,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import socket
 import threading
 import time
 from collections import deque
@@ -70,6 +81,21 @@ __all__ = [
 #: bump when the JSONL record shape changes (OBSERVABILITY.md documents it)
 SCHEMA_VERSION = 1
 
+#: every env knob the observability/fault stack reads — THE list, consumed
+#: by ``launch.remote`` (shipped to every host: a fleet whose ranks ran
+#: without telemetry cannot be skew-analyzed after the fact) and by the
+#: doctor's telemetry section.  Add new knobs here, not in the consumers.
+OBSERVABILITY_ENV_VARS = (
+    "TPUFRAME_TELEMETRY_DIR",
+    "TPUFRAME_TELEMETRY_MAX_MB",
+    "TPUFRAME_TELEMETRY_KEEP",
+    "TPUFRAME_WATCHDOG_S",
+    "TPUFRAME_WATCHDOG_DEADLINES",
+    "TPUFRAME_STRAGGLER_STEPS",
+    "TPUFRAME_STRAGGLER_FACTOR",
+    "TPUFRAME_PREEMPT_SIGNALS",
+)
+
 
 def _env_rank() -> int:
     """Process rank from the launch env (never imports jax: telemetry must
@@ -79,6 +105,26 @@ def _env_rank() -> int:
         if v.isdigit():
             return int(v)
     return 0
+
+
+def _env_max_bytes() -> int:
+    """Rotation threshold from TPUFRAME_TELEMETRY_MAX_MB (0 = unbounded).
+    Lenient like every observability knob: garbage (including ``inf``,
+    which would overflow int()) reads as "no cap", never as a crash."""
+    v = os.environ.get("TPUFRAME_TELEMETRY_MAX_MB", "")
+    try:
+        mb = float(v)
+    except ValueError:
+        return 0
+    return int(mb * 2**20) if 0 < mb < 2**40 else 0
+
+
+def _env_keep_segments() -> int:
+    """Rotated segments to retain; 0 is honored as "keep none" (rotation
+    just truncates) — silently coercing it up would surprise exactly the
+    disk-constrained operator who set it."""
+    v = os.environ.get("TPUFRAME_TELEMETRY_KEEP", "")
+    return int(v) if v.isdigit() else 3
 
 
 # -- metrics registry ---------------------------------------------------------
@@ -295,6 +341,10 @@ class Telemetry:
       watchdog: a ``track.watchdog.Watchdog`` to attach (wires both ways).
       span_histograms: auto-observe every span duration into
         ``span/<name>`` in the registry.
+      max_bytes: rotate the JSONL file once it reaches this size
+        (default: TPUFRAME_TELEMETRY_MAX_MB; 0 = never rotate).
+      keep_segments: rotated segments retained as ``<path>.1`` (newest)
+        .. ``<path>.K`` (oldest); the analyzer reads them back in order.
     """
 
     def __init__(
@@ -306,12 +356,26 @@ class Telemetry:
         registry: MetricsRegistry | None = None,
         watchdog: Any = None,
         span_histograms: bool = True,
+        max_bytes: int | None = None,
+        keep_segments: int | None = None,
     ):
         self.jsonl_path = jsonl_path
         self.rank = _env_rank() if rank is None else int(rank)
         self.registry = registry or MetricsRegistry()
         self.span_histograms = span_histograms
+        self.max_bytes = _env_max_bytes() if max_bytes is None else int(max_bytes)
+        self.keep_segments = (
+            _env_keep_segments() if keep_segments is None
+            else max(0, int(keep_segments))
+        )
+        # clock anchor pair: every record carries a wall ts AND a monotonic
+        # ts; the pair below (also published in the meta record) lets the
+        # fleet analyzer map this rank's monotonic clock onto the wall
+        # timeline fixed at configure time — immune to mid-run NTP steps
+        self.anchor_wall = time.time()
+        self.anchor_mono = time.monotonic()
         self._recent: deque[dict] = deque(maxlen=max_events)
+        self._bytes = 0  # current JSONL segment size (approx, for rotation)
         # _lock guards only in-memory state (span stacks, ring buffer) and
         # is never held across file I/O: the watchdog reads active_spans/
         # recent_events under it WHILE a JSONL write may be hung on a dead
@@ -326,6 +390,25 @@ class Telemetry:
         self.watchdog = None
         if watchdog is not None:
             self.attach_watchdog(watchdog)
+        if self.jsonl_path is not None:
+            # a sink-backed log's FIRST line is the meta record: rank
+            # identity + the clock anchor pair must precede any event the
+            # fleet analyzer would need to place on the shared timeline
+            self._write(self._meta_fields())
+
+    def _meta_fields(self) -> dict:
+        try:
+            hostname = socket.gethostname()
+        except OSError:
+            hostname = ""
+        return {
+            "kind": "meta",
+            "name": "telemetry/meta",
+            "schema": SCHEMA_VERSION,
+            "hostname": hostname,
+            "anchor_wall": round(self.anchor_wall, 6),
+            "anchor_mono": round(self.anchor_mono, 6),
+        }
 
     # -- wiring --------------------------------------------------------------
     def attach_watchdog(self, watchdog: Any) -> Any:
@@ -416,15 +499,19 @@ class Telemetry:
         with self._lock:
             return list(self._recent)[-n:]
 
-    def _write(self, rec: dict) -> None:
-        rec = {
+    def _envelope(self, rec: dict) -> dict:
+        return {
             "v": SCHEMA_VERSION,
             "ts": round(time.time(), 6),
+            "mono": round(time.monotonic(), 6),
             "rank": self.rank,
             "pid": os.getpid(),
             "thread": threading.current_thread().name,
             **rec,
         }
+
+    def _write(self, rec: dict) -> None:
+        rec = self._envelope(rec)
         with self._lock:
             self._recent.append(rec)
         if self.jsonl_path is None:
@@ -439,12 +526,48 @@ class Telemetry:
                     if d:
                         os.makedirs(d, exist_ok=True)
                     self._file = open(self.jsonl_path, "a")
+                    self._bytes = self._file.tell()  # append mode: file size
                 self._file.write(line)
                 self._file.flush()
+                # encoded size, not len(line): non-ASCII payloads (error
+                # strings, hostnames) are 2-4 UTF-8 bytes per char, and
+                # undercounting would let the segment overshoot the cap
+                # the disk-constrained operator set
+                self._bytes += len(line.encode("utf-8", "replace"))
+                if self.max_bytes and self._bytes >= self.max_bytes:
+                    self._rotate_locked()
             except OSError:
                 # a full/readonly disk must never take the training loop
                 # down with it; drop to memory-only
                 self._file, self.jsonl_path = None, None
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path -> path.1 -> ... -> path.K`` (oldest dropped) and
+        reopen a fresh segment headed by its own meta record, so each
+        segment is independently alignable.  ``keep_segments=0`` keeps no
+        history: the full file is simply dropped.  Caller holds
+        ``_io_lock``."""
+        base = self.jsonl_path
+        self._file.close()
+        self._file = None
+        if self.keep_segments == 0:
+            os.remove(base)
+        else:
+            oldest = f"{base}.{self.keep_segments}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for k in range(self.keep_segments - 1, 0, -1):
+                src = f"{base}.{k}"
+                if os.path.exists(src):
+                    os.replace(src, f"{base}.{k + 1}")
+            os.replace(base, f"{base}.1")
+        self._file = open(base, "a")
+        # direct write, not _write: we already hold _io_lock, and the
+        # rotation meta is a file header, not a ring-buffer event
+        head = json.dumps(self._envelope(self._meta_fields()), default=str) + "\n"
+        self._file.write(head)
+        self._file.flush()
+        self._bytes = len(head.encode("utf-8", "replace"))
 
     def close(self) -> None:
         """Terminal: later writes stay memory-only (a prefetcher thread

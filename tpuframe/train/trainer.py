@@ -35,6 +35,7 @@ from tpuframe.data.loader import DataLoader, DevicePrefetcher
 from tpuframe.fault import chaos
 from tpuframe.fault import preempt as _preempt
 from tpuframe.fault.preempt import Preempted
+from tpuframe.track.analyze import StragglerMonitor
 from tpuframe.track.telemetry import get_telemetry
 from tpuframe.parallel.precision import Policy, align_model_dtype, get_policy
 from tpuframe.parallel.sharding import ParallelPlan
@@ -121,6 +122,16 @@ class Trainer:
         step, so the flag check is an all-gather at a fixed step cadence
         (single-process checks locally every step; the collective only
         exists on pods).
+      straggler_sync_steps / straggler_factor: live slow-rank detection
+        (``tpuframe.track.analyze.StragglerMonitor``).  Every rank keeps
+        a rolling step-time EWMA (``train/step_ewma_s`` gauge); every
+        ``straggler_sync_steps`` steps the EWMAs cross ranks through a
+        tiny all-gather (degraded to a self-baseline off-pod) and a rank
+        exceeding the fleet median by ``straggler_factor`` emits a
+        ``train/straggler`` event + the ``train/skew_ratio`` gauge.
+        Defaults come from ``TPUFRAME_STRAGGLER_STEPS`` (0 disables;
+        else 32) and ``TPUFRAME_STRAGGLER_FACTOR`` (2.0), which launch
+        propagates to every worker.
     """
 
     def __init__(
@@ -155,6 +166,8 @@ class Trainer:
         ema_decay: float | None = None,
         preemption: Any = None,
         preempt_sync_steps: int = 16,
+        straggler_sync_steps: int | None = None,
+        straggler_factor: float | None = None,
     ):
         if precision is None:
             # follow the model: an explicitly-bf16 model keeps bf16 compute
@@ -196,6 +209,11 @@ class Trainer:
             )
         self.preemption = preemption
         self.preempt_sync_steps = preempt_sync_steps
+        # live slow-rank detection: persists across epochs (the EWMA and
+        # the self-baseline window are run-scoped, not epoch-scoped)
+        self._straggler = StragglerMonitor(
+            sync_steps=straggler_sync_steps, factor=straggler_factor
+        )
 
         if plan is None:
             plan = ParallelPlan(mesh=rt.current_runtime().mesh)
@@ -768,6 +786,9 @@ class Trainer:
             return out
 
         batches = iter(self._device_batches(self.train_dataloader, train=True))
+        # straggler boundary: the gap back to the previous epoch (eval,
+        # epoch-end checkpoint) must not read as one slow step
+        self._straggler.mark()
         while True:
             # chaos site: a scheduled loader fault raises here, exactly
             # where a real worker-pool / shard-fetch failure surfaces
@@ -776,20 +797,27 @@ class Trainer:
                 batch = next(batches, _epoch_end)
             if batch is _epoch_end:
                 break  # the exhausted final pull never counted toward data_wait
-            data_wait += sp.elapsed
+            wait_s = sp.elapsed
+            data_wait += wait_s
             if self._done() or self._stop_reason is not None:
                 break
             self._emit("on_step_start")
             chaos.maybe_fire("step", step=self.batches_seen)
             # the guard turns a wedged dispatch (first-step compile, stuck
             # collective) into an attributed watchdog report instead of a
-            # silent hang; unmonitored unless a watchdog is configured
-            with tele.span("train/step", batch=self.batches_seen) as sp, \
+            # silent hang; unmonitored unless a watchdog is configured.
+            # data_wait_s rides as a span attr so the fleet analyzer can
+            # classify this step input-bound without a second JSONL line.
+            with tele.span("train/step", batch=self.batches_seen,
+                           data_wait_s=round(wait_s, 6)) as sp, \
                     tele.guard("train/step"):
                 self.state, metrics = self._train_step(self.state, batch)
             dispatch += sp.elapsed
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
+            # boundary-to-boundary step time: charges whatever actually
+            # slowed this rank (wait, dispatch, snapshot, callback)
+            self._straggler.observe()
             if (
                 self.checkpointer is not None
                 and self.checkpoint_interval_batches
