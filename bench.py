@@ -58,16 +58,23 @@ _PEAK_BF16 = [
 def enable_compile_cache(default_dir: str = "/tmp/tpuframe_xla_cache") -> None:
     """Point JAX at the persistent compile cache (idempotent).
 
-    One shared helper for bench.py and every benchmarks/ script so the
-    cache path and knobs can't drift between them; safe on jax versions
+    Delegates to the compile spine (``tpuframe.compile.cache``) so the
+    bench and the trainer share ONE cache path, eviction policy and
+    telemetry (hit/miss counters) — two ad-hoc cache setups drifting
+    apart is exactly what the spine exists to prevent.  The bench's
+    legacy ``JAX_COMPILATION_CACHE_DIR`` default is honored when the
+    ``TPUFRAME_COMPILE_CACHE`` knob is unset; safe on jax versions
     without the config knobs (cache is an optimization only).
     """
-    cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", default_dir)
-    import jax
-
     try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from tpuframe.compile import cache as compile_cache
+
+        if os.environ.get("TPUFRAME_COMPILE_CACHE"):
+            compile_cache.enable_from_env()
+        else:
+            compile_cache.enable(
+                os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", default_dir)
+            )
     except Exception:
         pass
 

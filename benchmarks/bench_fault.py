@@ -54,6 +54,22 @@ def build_trainer(ds, ckpt, *, snapshot_every, epochs, callbacks=()):
     )
 
 
+def _compile_snapshot() -> dict:
+    """Registry totals that decompose a recovery window: checkpoint
+    restore wall, compile wall (lower + backend), persistent-cache
+    traffic.  Deltas between two snapshots attribute the window."""
+    from tpuframe.track.telemetry import get_telemetry
+
+    reg = get_telemetry().registry
+    return {
+        "restore": reg.histogram("span/ckpt/restore").total,
+        "backend": reg.histogram("compile/backend_compile_s").total,
+        "lower": reg.histogram("compile/lower_s").total,
+        "hits": reg.counter("compile/cache_hits").value,
+        "misses": reg.counter("compile/cache_misses").value,
+    }
+
+
 def measure_recovery(workdir: str, args) -> dict:
     """Window 1: seeded mid-epoch kill -> supervised restart -> resume."""
     from tpuframe.ckpt import Checkpointer
@@ -67,7 +83,8 @@ def measure_recovery(workdir: str, args) -> dict:
         num_classes=4, seed=0,
     )
     ckpt_dir = os.path.join(workdir, "recovery_ck")
-    timeline: dict = {"attempt_first_step_t": [], "resume_start_step": []}
+    timeline: dict = {"attempt_first_step_t": [], "resume_start_step": [],
+                      "first_step_snap": []}
 
     class Probe(Callback):
         """First-completed-step wall-clock + the step each attempt
@@ -88,6 +105,7 @@ def measure_recovery(workdir: str, args) -> dict:
             if not self.saw_step:
                 self.saw_step = True
                 timeline["attempt_first_step_t"].append(time.perf_counter())
+                timeline["first_step_snap"].append(_compile_snapshot())
 
     def attempt():
         ck = Checkpointer(ckpt_dir)
@@ -113,10 +131,12 @@ def measure_recovery(workdir: str, args) -> dict:
     )
     kill_step = plan.injectors[0].step
     fail_t: list[float] = []
+    fail_snap: list[dict] = []
     last_ckpt_step: list[int] = []
 
     def on_restart(attempt_n, error):
         fail_t.append(time.perf_counter())
+        fail_snap.append(_compile_snapshot())
         last_ckpt_step.append(latest_step(ckpt_dir + "_intra") or 0)
 
     sup = Supervisor(
@@ -132,6 +152,15 @@ def measure_recovery(workdir: str, args) -> dict:
     # first completed step of attempt 2 minus the failure instant
     recovery_wall_s = timeline["attempt_first_step_t"][1] - fail_t[0]
     resumed_step = timeline["resume_start_step"][1]
+    # component split across the recovery window (failure -> first
+    # post-restart step): checkpoint restore, compile (trace+lower plus
+    # backend compile OR cache retrieval), and everything else (Trainer
+    # re-construction, loader spin-up, the step itself)
+    a, b = fail_snap[0], timeline["first_step_snap"][1]
+    restore_s = b["restore"] - a["restore"]
+    compile_s = (b["backend"] - a["backend"]) + (b["lower"] - a["lower"])
+    from tpuframe.compile import cache as compile_cache
+
     return {
         "kill_seed": args.kill_seed,
         "kill_site": "loader",
@@ -144,6 +173,16 @@ def measure_recovery(workdir: str, args) -> dict:
         "expected_final_step": args.steps_per_epoch * args.epochs,
         "restarts": sup.retries,
         "recovery_wall_s": round(recovery_wall_s, 3),
+        "recovery_components": {
+            "restore_s": round(restore_s, 3),
+            "compile_s": round(compile_s, 3),
+            "other_s": round(
+                max(recovery_wall_s - restore_s - compile_s, 0.0), 3
+            ),
+            "cache_hits": b["hits"] - a["hits"],
+            "cache_misses": b["misses"] - a["misses"],
+        },
+        "compile_cache": compile_cache.enabled_dir() is not None,
         "total_wall_s": round(total_s, 3),
     }
 
@@ -233,17 +272,41 @@ def main(argv=None):
 
     import jax
 
-    recovery = measure_recovery(workdir, args)
+    from tpuframe.core import runtime as rt
+    from tpuframe.compile import cache as compile_cache
+
+    # recovery is measured twice: a COLD window (persistent compile
+    # cache off — the pre-compile-spine behavior, attempt 2 pays a full
+    # recompile) and a WARM window (fresh cache dir — attempt 1 writes
+    # every program, the restart retrieves them).  The delta is the
+    # compile spine's contribution to recovery; warm is the shipped
+    # default and the headline value.
+    rt.current_runtime()  # initialize (and its enable_from_env) first
+    # env-level disable: the supervisor's own warm-start hook calls
+    # enable_from_env() before each run, which would silently re-enable
+    # a merely disable()d cache mid-window
+    os.environ["TPUFRAME_COMPILE_CACHE"] = "0"
+    compile_cache.disable()
+    recovery_cold = measure_recovery(os.path.join(workdir, "cold"), args)
+    warm_dir = tempfile.mkdtemp(prefix="tpuframe_bf_cache_")
+    os.environ["TPUFRAME_COMPILE_CACHE"] = warm_dir
+    compile_cache.enable(warm_dir)
+    recovery = measure_recovery(os.path.join(workdir, "warm"), args)
     stall = measure_ckpt_stall(workdir, args)
     print(json.dumps({
         "metric": "fault_recovery_wall_s",
         "value": recovery["recovery_wall_s"],
         "unit": ("seconds from injected mid-epoch kill to first completed "
-                 "post-restart step (re-init + restore + recompile + step; "
-                 f"MnistNet 28px b16, {jax.default_backend()})"),
+                 "post-restart step (re-init + restore + compile-or-"
+                 "retrieve + step, warm compile cache; MnistNet 28px b16, "
+                 f"{jax.default_backend()})"),
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "recovery": recovery,
+        "recovery_cold": recovery_cold,
+        "warm_cache_recovery_delta_s": round(
+            recovery_cold["recovery_wall_s"] - recovery["recovery_wall_s"], 3
+        ),
         "ckpt_stall": stall,
     }))
 

@@ -151,6 +151,13 @@ run bench_fault.json           300  python benchmarks/bench_fault.py
 # cheap, so it rides with the fault rung above the long tail
 run analyze_selftest.json      300  python benchmarks/bench_analyze.py
 
+# compile-spine rung: cold vs warm-cache vs AOT-overlapped
+# time-to-first-step on the real chip — the committed
+# time_to_first_step block is what `track analyze --baseline` gates
+# startup/compile regressions against (exit 3); cheap, rides with the
+# fault/analyze pair above the long tail
+run bench_compile.json         300  python benchmarks/bench_compile.py
+
 # input-side capacity, no chip required (VERDICT r05 weak #1/#2): the
 # producer ceiling per worker count and the native decode-thread scaling
 # curve — on the TPU host these calibrate "~N cores feed one chip"

@@ -13,6 +13,7 @@ stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
 - ``tpuframe.track``    — MLflow-compatible experiment tracking
 - ``tpuframe.ckpt``     — sharded checkpoint save/restore (orbax-backed)
 - ``tpuframe.fault``    — preemption watcher, chaos injection, supervised restarts
+- ``tpuframe.compile``  — persistent XLA compile cache, AOT warm-start, shape guard
 - ``tpuframe.ops``      — Pallas TPU kernels for hot ops
 - ``tpuframe.serve``    — portable StableHLO inference artifacts (jax.export)
 """
@@ -20,6 +21,7 @@ stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
 __version__ = "0.3.0"  # single source: pyproject reads this via setuptools dynamic
 
 _SUBMODULES = (
+    "compile",
     "core",
     "data",
     "models",

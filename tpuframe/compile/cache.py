@@ -1,0 +1,402 @@
+"""Persistent XLA compilation cache: the warm-start half of the compile spine.
+
+Every cold start, eval switch, and supervised restart pays a full XLA
+trace+compile on the hot path — ``bench_fault_cpu.json`` charges the
+recompile inside its recovery wall, and the fleet analyzer must
+special-case the first step because compile jitter pollutes skew numbers.
+jax ships a persistent compilation cache that turns a repeat backend
+compile into a file read; nothing in tpuframe wired it.  This module is
+that wiring, shaped like the rest of the observability stack:
+
+- :func:`enable` points jax's compilation cache at a directory (default:
+  a host-shared location under the local scratch, so a supervised
+  restart or a *new rank on the same host* hits warm cache), drops the
+  min-compile-time floor so small steps cache too, and installs
+  monitoring listeners that surface every compile in tpuframe telemetry.
+- :func:`trim` is the size-capped keep-K eviction, mirroring the
+  telemetry-rotation pattern (``TPUFRAME_TELEMETRY_MAX_MB`` /
+  ``TPUFRAME_TELEMETRY_KEEP``): newest entries always survive, oldest
+  are evicted once the directory exceeds the cap, evictions are counted.
+- The **listeners** map jax's ``/jax/compilation_cache/*`` and
+  ``/jax/core/compile/*`` monitoring events into the metrics registry
+  (``compile/cache_hits``, ``compile/cache_misses``,
+  ``compile/backend_compiles`` counters; ``compile/backend_compile_s``,
+  ``compile/lower_s`` histograms) and emit one loud
+  ``compile/backend_compile`` JSONL event per *real* backend compile —
+  a persistent-cache hit is a retrieval, not a compile, and is counted
+  but not shouted.
+
+Env knobs (``COMPILE_ENV_VARS`` — shipped to every remote worker by
+``launch.remote`` and printed by the doctor, exactly like
+``telemetry.OBSERVABILITY_ENV_VARS``)::
+
+    TPUFRAME_COMPILE_CACHE         cache dir; 0/off/false disables; unset
+                                   = <local scratch>/compile_cache
+    TPUFRAME_COMPILE_CACHE_MAX_MB  trim() size cap (default 1024; junk =
+                                   unbounded, lenient like telemetry)
+    TPUFRAME_COMPILE_CACHE_KEEP    newest entries never evicted (default 16)
+    TPUFRAME_COMPILE_MIN_COMPILE_S only cache compiles at least this long
+                                   (default 0: cache everything — trim()
+                                   bounds the disk, not a time floor)
+    TPUFRAME_PRECOMPILE            0 disables the Trainer's AOT warm-start
+
+This module imports jax lazily (inside :func:`enable`): the doctor and
+``launch.remote`` read :data:`COMPILE_ENV_VARS` and :func:`cache_info`
+from processes whose backend may be wedged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import tempfile
+import threading
+from typing import Any, Iterator
+
+from tpuframe.track.telemetry import get_telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "COMPILE_ENV_VARS",
+    "cache_dir_from_env",
+    "cache_info",
+    "compile_label",
+    "disable",
+    "enable",
+    "enable_from_env",
+    "enabled_dir",
+    "install_listeners",
+    "trim",
+]
+
+#: every env knob the compile spine reads — THE list, consumed by
+#: ``launch.remote`` (shipped to every host next to
+#: ``telemetry.OBSERVABILITY_ENV_VARS``) and by the doctor's compile
+#: section.  Add new knobs here, not in the consumers.
+COMPILE_ENV_VARS = (
+    "TPUFRAME_COMPILE_CACHE",
+    "TPUFRAME_COMPILE_CACHE_MAX_MB",
+    "TPUFRAME_COMPILE_CACHE_KEEP",
+    "TPUFRAME_COMPILE_MIN_COMPILE_S",
+    "TPUFRAME_PRECOMPILE",
+)
+
+_FALSY = ("0", "false", "no", "off", "disabled")
+
+#: process-wide state: the enabled cache dir (None = not enabled here)
+_STATE: dict[str, Any] = {"dir": None, "listeners": False}
+
+#: per-thread compile attribution: what is being compiled right now
+#: (set by the AOT precompiler and the Trainer's jit-fallback path) and
+#: whether an explicit compile span is already recording it (suppresses
+#: the listener's duplicate JSONL event; histograms still observe).
+_TLS = threading.local()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def cache_dir_from_env() -> str | None:
+    """Resolve the cache directory from ``TPUFRAME_COMPILE_CACHE``.
+
+    Unset -> a host-shared default under the local scratch (the same
+    root ``Workspace.local_scratch`` uses, WITHOUT the per-rank subdir:
+    every rank on a host shares one cache, which is the point).  An
+    explicitly falsy value disables the cache entirely.
+    """
+    v = os.environ.get("TPUFRAME_COMPILE_CACHE", "").strip()
+    if v and v.lower() in _FALSY:
+        return None
+    if v:
+        return v
+    base = os.environ.get("TPUFRAME_LOCAL_SCRATCH") or os.path.join(
+        tempfile.gettempdir(), "tpuframe_scratch"
+    )
+    return os.path.join(base, "compile_cache")
+
+
+def enabled_dir() -> str | None:
+    """The cache dir this process enabled (None when disabled)."""
+    return _STATE["dir"]
+
+
+def enable(cache_dir: str | None = None, *,
+           min_compile_s: float | None = None) -> str | None:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; returns the enabled directory (or None when disabled by
+    env / jax too old / dir uncreatable — a broken cache must degrade to
+    today's cold-compile behavior, never take training down).  Also
+    installs the telemetry listeners and runs a :func:`trim` pass so a
+    long-lived host cache stays inside its size cap.
+    """
+    cache_dir = cache_dir or cache_dir_from_env()
+    if cache_dir is None:
+        return None
+    if min_compile_s is None:
+        min_compile_s = _env_float("TPUFRAME_COMPILE_MIN_COMPILE_S", 0.0)
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        # cache small steps too: the floor exists to avoid caching
+        # trivial compiles, but tpuframe bounds the cache by SIZE (trim)
+        # rather than excluding exactly the restarts it wants to warm
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_s)
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # the dir knob goes LAST: a partial failure above must not leave
+        # jax writing a cache the spine believes is off (trim never runs,
+        # doctor/supervisor report warm-start disabled while it is live)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # jax memoizes its "is the cache used?" verdict at the first
+        # compile of the task; a compile that ran before this enable()
+        # (or after a disable()) froze it at False — reset so the next
+        # compile re-evaluates against the fresh config
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # old jax / readonly dir / exotic backend
+        logger.warning("compile cache disabled: %s", e)
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        return None
+    _TLS.verdict = None  # a pre-enable hit must not shadow the next compile
+    _STATE["dir"] = cache_dir
+    install_listeners()
+    try:
+        trim(cache_dir)
+    except OSError:
+        pass  # a concurrent trimmer or a vanishing entry is not an error
+    return cache_dir
+
+
+def enable_from_env() -> str | None:
+    """Enable iff the env doesn't explicitly disable it — the hook
+    ``core.runtime.initialize`` and the fault supervisor call."""
+    return enable()
+
+
+def disable() -> None:
+    """Turn the persistent cache off again (tests, benchmarks' cold
+    windows).  Listeners stay installed — they are harmless without a
+    cache and jax offers no unregister."""
+    _STATE["dir"] = None
+    _TLS.verdict = None  # a stale 'hit' would mute the next real compile
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+# -- telemetry listeners ------------------------------------------------------
+
+
+@contextlib.contextmanager
+def compile_label(label: str, *, span: bool = False) -> Iterator[None]:
+    """Attribute any backend compile on this thread to ``label`` (what
+    shows on the ``compile/backend_compile`` event).  ``span=True``
+    additionally marks that an explicit compile span is recording the
+    region, so the listener does not emit a duplicate JSONL event."""
+    prev_label = getattr(_TLS, "label", None)
+    prev_span = getattr(_TLS, "in_span", False)
+    _TLS.label = label
+    _TLS.in_span = bool(span) or prev_span
+    try:
+        yield
+    finally:
+        _TLS.label = prev_label
+        _TLS.in_span = prev_span
+
+
+def _on_event(name: str, **kw: Any) -> None:
+    # verdict protocol: each compile request that consults the
+    # persistent cache records hit/miss on this thread; the
+    # backend_compile duration that follows reads (and clears) it.
+    try:
+        if name == "/jax/compilation_cache/compile_requests_use_cache":
+            _TLS.verdict = "miss"  # until a hit proves otherwise
+        elif name == "/jax/compilation_cache/cache_hits":
+            _TLS.verdict = "hit"
+            get_telemetry().registry.counter("compile/cache_hits").inc()
+        elif name == "/jax/compilation_cache/cache_misses":
+            _TLS.verdict = "miss"
+            get_telemetry().registry.counter("compile/cache_misses").inc()
+    except Exception:  # a metrics hiccup must never break a compile
+        pass
+
+
+def _on_duration(name: str, dur: float, **kw: Any) -> None:
+    try:
+        tele = get_telemetry()
+        if name == "/jax/core/compile/backend_compile_duration":
+            tele.registry.histogram("compile/backend_compile_s").observe(dur)
+            verdict = getattr(_TLS, "verdict", None)
+            _TLS.verdict = None
+            # a persistent-cache hit is a retrieval, not a compile; a
+            # miss — or a compile that never consulted the cache — is
+            # the real thing, counted and (unless an explicit compile
+            # span is already recording it) shouted as one JSONL event
+            if verdict != "hit":
+                tele.registry.counter("compile/backend_compiles").inc()
+                if not getattr(_TLS, "in_span", False):
+                    tele.event(
+                        "compile/backend_compile",
+                        dur_s=round(float(dur), 6),
+                        label=getattr(_TLS, "label", None),
+                        persistent_cache=(
+                            verdict if _STATE["dir"] else "disabled"
+                        ),
+                    )
+        elif name in (
+            "/jax/core/compile/jaxpr_trace_duration",
+            "/jax/core/compile/jaxpr_to_mlir_module_duration",
+        ):
+            tele.registry.histogram("compile/lower_s").observe(dur)
+    except Exception:
+        pass
+
+
+def install_listeners() -> None:
+    """Register the jax monitoring listeners once per process (jax's
+    listener registry is append-only — double registration would double
+    every counter)."""
+    if _STATE["listeners"]:
+        return
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _STATE["listeners"] = True
+    except Exception as e:
+        logger.debug("compile listeners unavailable: %s", e)
+
+
+# -- keep-K / size-cap eviction ----------------------------------------------
+
+
+def _entry_files(cache_dir: str) -> list[tuple[str, float, int]]:
+    """(path, recency, bytes) per cache entry, newest first.  jax's file
+    cache writes ``<key>-cache`` entries with an ``<key>-atime`` recency
+    sidecar; older layouts use bare key files — both are handled, and
+    recency falls back to the entry's own mtime."""
+    out = []
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name.endswith("-atime"):
+            continue
+        path = os.path.join(cache_dir, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue  # concurrent eviction
+        if not os.path.isfile(path):
+            continue
+        recency = st.st_mtime
+        if name.endswith("-cache"):
+            try:
+                recency = os.stat(
+                    os.path.join(cache_dir, name[: -len("-cache")] + "-atime")
+                ).st_mtime
+            except OSError:
+                pass
+        out.append((path, recency, st.st_size))
+    out.sort(key=lambda e: e[1], reverse=True)
+    return out
+
+
+def trim(cache_dir: str | None = None, *, max_bytes: int | None = None,
+         keep: int | None = None) -> list[str]:
+    """Size-capped keep-K eviction, the telemetry-rotation pattern
+    applied to the compile cache: the newest ``keep`` entries always
+    survive; beyond them, oldest entries are evicted until the directory
+    fits ``max_bytes``.  Evictions are counted
+    (``compile/cache_evictions``) and returned.  Lenient knobs: junk in
+    ``TPUFRAME_COMPILE_CACHE_MAX_MB`` reads as "no cap", never a crash.
+    """
+    cache_dir = cache_dir or _STATE["dir"] or cache_dir_from_env()
+    if cache_dir is None or not os.path.isdir(cache_dir):
+        return []
+    if max_bytes is None:
+        mb = _env_float("TPUFRAME_COMPILE_CACHE_MAX_MB", 1024.0)
+        max_bytes = int(mb * 2**20) if 0 < mb < 2**40 else 0
+    if keep is None:
+        v = os.environ.get("TPUFRAME_COMPILE_CACHE_KEEP", "")
+        keep = int(v) if v.isdigit() else 16
+    if not max_bytes:
+        return []
+    entries = _entry_files(cache_dir)
+    total = sum(size for _, _, size in entries)
+    evicted: list[str] = []
+    # walk oldest-first past the protected keep-K prefix
+    for path, _, size in reversed(entries[max(0, int(keep)):]):
+        if total <= max_bytes:
+            break
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass  # a concurrent trimmer won the race: it IS gone
+        except OSError:
+            # EACCES/EROFS (foreign-owned entries in a shared host dir):
+            # the bytes are still there — accounting them as freed would
+            # end the pass early and report evictions that never happened
+            continue
+        if path.endswith("-cache"):
+            try:
+                os.remove(path[: -len("-cache")] + "-atime")
+            except OSError:
+                pass
+        total -= size
+        evicted.append(path)
+    if evicted:
+        get_telemetry().registry.counter("compile/cache_evictions").inc(
+            len(evicted)
+        )
+        get_telemetry().event(
+            "compile/cache_evict", n=len(evicted), dir=cache_dir
+        )
+    return evicted
+
+
+def cache_info(cache_dir: str | None = None) -> dict:
+    """Doctor-ready snapshot: where the cache is (or would be), how many
+    entries it holds, how big it is, and the knobs bounding it.  Never
+    imports jax — callable from a wedged-backend diagnosis."""
+    cache_dir = cache_dir or _STATE["dir"] or cache_dir_from_env()
+    info: dict[str, Any] = {
+        "dir": cache_dir,
+        "enabled_in_process": _STATE["dir"] is not None,
+        "entries": 0,
+        "total_mb": 0.0,
+    }
+    if cache_dir and os.path.isdir(cache_dir):
+        entries = _entry_files(cache_dir)
+        info["entries"] = len(entries)
+        info["total_mb"] = round(
+            sum(size for _, _, size in entries) / 2**20, 3
+        )
+    mb = _env_float("TPUFRAME_COMPILE_CACHE_MAX_MB", 1024.0)
+    info["max_mb"] = mb if 0 < mb < 2**40 else None
+    v = os.environ.get("TPUFRAME_COMPILE_CACHE_KEEP", "")
+    info["keep"] = int(v) if v.isdigit() else 16
+    return info

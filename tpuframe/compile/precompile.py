@@ -1,0 +1,234 @@
+"""AOT warm-start + recompile-proof step shapes: the other half of the
+compile spine.
+
+The persistent cache (``compile.cache``) makes a *repeat* compile cheap;
+this module removes the remaining first-step serialization and makes the
+step-shape contract explicit:
+
+- :func:`batch_signature` / :func:`format_signature` — the canonical
+  hashable identity of a step's batch operands (key, shape, dtype per
+  leaf).  One signature == one XLA program.
+- :func:`loader_batch_template` — derive the full batch signature a
+  Trainer's loader will produce *before any data flows*: sample shape +
+  ``transfer_dtype`` from the loader spec, the host algorithm pipeline
+  probed on a tiny zeros batch (MixUp/CutMix change label rank and image
+  dtype), the eval ragged-tail ``weight`` mask, and the grad-accum
+  ``(n_micro, micro, ...)`` reshape.  Static shapes are the loader's
+  contract (ragged tails are padded, never leaked), so each loader has
+  exactly ONE signature — the "full set" is {train, eval}.
+- :func:`precompile_step` — ``jit_fn.lower(abstract_args).compile()``
+  under ``compile/lower`` + ``compile/backend_compile`` spans.  The
+  returned executable is the *same program* the jit call would build,
+  minus tracing: the Trainer dispatches straight to it when the runtime
+  batch matches the signature (a ~ms call instead of a re-trace), and
+  the lowering also populates the persistent cache so even the fallback
+  jit path retrieves instead of recompiling.
+- :class:`ShapeGuard` — armed by precompile with the expected signature
+  set; any runtime signature outside it emits ONE loud
+  ``compile/recompile`` JSONL event naming the offending signature (and
+  increments ``compile/recompiles``), so a silent per-step recompile —
+  the classic "training is mysteriously 100x slower" failure — becomes a
+  grep-able line instead.
+
+Everything here degrades: templates that can't be derived (duck-typed
+loaders without a spec) simply skip precompile; an executable whose
+sharding no longer matches falls back to the jit path with a
+``compile/aot_fallback`` event.  The Trainer owns the thread that
+overlaps all of this with loader spin-up.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpuframe.compile.cache import compile_label
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = [
+    "ShapeGuard",
+    "abstract_state",
+    "batch_signature",
+    "format_signature",
+    "loader_batch_template",
+    "precompile_step",
+]
+
+
+def batch_signature(batch) -> tuple:
+    """Hashable identity of a batch pytree (dict of array-likes): sorted
+    (key, shape, dtype) triples.  Works on numpy arrays, jax Arrays and
+    ``ShapeDtypeStruct`` templates alike."""
+    return tuple(
+        sorted(
+            (k, tuple(int(s) for s in v.shape), np.dtype(v.dtype).name)
+            for k, v in batch.items()
+        )
+    )
+
+
+def format_signature(sig: tuple) -> str:
+    """``image:(32,28,28,1):float32 label:(32,):int32`` — the loud,
+    grep-able form events carry."""
+    return " ".join(
+        f"{k}:({','.join(map(str, shape))}):{dtype}" for k, shape, dtype in sig
+    )
+
+
+def abstract_state(state):
+    """ShapeDtypeStructs mirroring a live TrainState — shapes, dtypes AND
+    shardings, so the lowered program matches what the real call sees
+    (a mismatched input sharding would compile a different program)."""
+    import jax
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(leaf, state)
+
+
+def _expand_sharding(sharding, ndim: int):
+    """Pad a batch sharding's spec to ``ndim`` (trailing dims replicated)
+    — the same rule ``DevicePrefetcher.sharding_for`` applies."""
+    import jax
+
+    spec = list(sharding.spec) + [None] * (ndim - len(sharding.spec))
+    return jax.sharding.NamedSharding(
+        sharding.mesh, jax.sharding.PartitionSpec(*spec)
+    )
+
+
+def loader_batch_template(trainer, train: bool) -> dict | None:
+    """The global abstract batch (dict of ``ShapeDtypeStruct``) the
+    Trainer's device pipeline will feed its jitted step, derived from
+    the loader spec alone.  None when underivable (duck-typed loader,
+    empty dataset) — precompile then simply skips this step."""
+    import jax
+
+    loader = trainer.train_dataloader if train else trainer.eval_dataloader
+    if loader is None or not hasattr(loader, "local_batch_size"):
+        return None
+    try:
+        img0, _ = loader.dataset[0]
+    except Exception:
+        return None
+    img0 = np.asarray(img0)
+    dtype = loader.transfer_dtype or img0.dtype
+    n = int(loader.local_batch_size)
+    accum = trainer.grad_accum if train else 1
+
+    # probe the host algorithm pipeline on a tiny zeros batch: MixUp and
+    # friends change label rank ((N,) int -> (N, C) float) and image
+    # dtype (uint8 -> float), and the signature must match what actually
+    # reaches the step.  Trailing dims and dtypes are batch-size
+    # invariant, so a small probe predicts the full batch.
+    algs = trainer.algorithms if train else []
+    probe_n = min(n, 8)
+    images = np.zeros((probe_n,) + img0.shape, dtype)
+    labels = np.zeros((probe_n,), np.int32)
+    if algs:
+        from tpuframe.train.algorithms import apply_algorithms
+
+        try:
+            images, labels = apply_algorithms(
+                algs, images, labels, np.random.default_rng(0)
+            )
+        except Exception:
+            return None  # unprobeable algorithm: skip rather than guess
+
+    def local_shape(arr: np.ndarray) -> tuple:
+        shape = (n,) + tuple(arr.shape[1:])
+        if accum > 1:
+            if n % accum:
+                return shape  # the step itself will raise; don't mask it
+            shape = (accum, n // accum) + tuple(arr.shape[1:])
+        return shape
+
+    template = {
+        "image": (local_shape(images), images.dtype),
+        "label": (local_shape(labels), labels.dtype),
+    }
+    if not getattr(loader, "drop_last", True):
+        # padded ragged tails ride a validity mask, which the Trainer's
+        # host pipeline forwards as a float32 ``weight`` on EVERY batch
+        template["weight"] = (local_shape(np.zeros((probe_n,))), np.float32)
+
+    # local -> global: the prefetcher assembles one global array per
+    # leaf, scaling the batch dim by the process count (dim 1 when the
+    # microbatch dim leads), sharded over the plan's data axes
+    batch_dim = 1 if accum > 1 else 0
+    pc = int(getattr(loader, "process_count", 1))
+    base = trainer.plan.batch_sharding(leading_microbatch=accum > 1)
+    out = {}
+    for key, (shape, dt) in template.items():
+        shape = list(shape)
+        shape[batch_dim] *= pc
+        out[key] = jax.ShapeDtypeStruct(
+            tuple(shape), np.dtype(dt), sharding=_expand_sharding(base, len(shape))
+        )
+    return out
+
+
+def precompile_step(fn, state, template: dict, *, label: str):
+    """AOT-lower and backend-compile ``fn(state, template_batch)``.
+
+    ``fn`` is a step callable from ``tpuframe.train.step`` — either the
+    jitted function itself or an offload wrapper exposing ``_inner_jit``.
+    Returns the compiled executable when it is directly dispatchable
+    (i.e. ``fn`` IS the jitted function — wrappers do per-call host work
+    the executable wouldn't), else None; in both cases the compile has
+    happened and the persistent cache is warm.
+    """
+    target = getattr(fn, "_inner_jit", fn)
+    if not hasattr(target, "lower"):
+        return None
+    tele = get_telemetry()
+    astate = abstract_state(state)
+    with tele.span("compile/lower", label=label):
+        lowered = target.lower(astate, template)
+    with tele.span("compile/backend_compile", label=label), \
+            compile_label(label, span=True):
+        compiled = lowered.compile()
+    return compiled if target is fn else None
+
+
+class ShapeGuard:
+    """Expected-signature set + the loud runtime-miss event.
+
+    Disarmed (no :meth:`expect` yet) it only records — a cold first
+    compile with precompile off is normal, not a recompile.  Armed, any
+    signature outside the expected set emits ONE ``compile/recompile``
+    event naming the offending signature, then adopts it (the event
+    marks the *change*, not every subsequent step at the new shape).
+    """
+
+    def __init__(self, telemetry=None):
+        self._telemetry = telemetry
+        self._known: set[tuple] = set()
+        self.armed = False
+
+    def _tele(self):
+        return self._telemetry if self._telemetry is not None else get_telemetry()
+
+    def expect(self, kind: str, sig: tuple) -> None:
+        """Register a precompiled signature; arms the guard."""
+        self._known.add((kind, sig))
+        self.armed = True
+
+    def check(self, kind: str, sig: tuple) -> bool:
+        """True when ``sig`` was expected; False (plus one loud event if
+        armed) on a runtime miss."""
+        key = (kind, sig)
+        if key in self._known:
+            return True
+        self._known.add(key)
+        if self.armed:
+            tele = self._tele()
+            tele.registry.counter("compile/recompiles").inc()
+            tele.event(
+                "compile/recompile",
+                step_kind=kind,
+                signature=format_signature(sig),
+            )
+        return False

@@ -196,6 +196,15 @@ def initialize(
         jax.config.update("jax_disable_most_optimizations", True)
         _DEBUG_FLAGS_SET = True
 
+    # persistent compilation cache: on by default (opt out with
+    # TPUFRAME_COMPILE_CACHE=0) so every process that initializes a
+    # runtime — driver, launch worker, supervised restart — compiles
+    # against the same host-shared cache.  Enabled before any mesh/jit
+    # work so even the first compile of this process is cacheable.
+    from tpuframe.compile import cache as _compile_cache
+
+    _compile_cache.enable_from_env()
+
     coordinator_address = coordinator_address or _env_coordinator()
     if num_processes is None:
         num_processes = _env_int("TPUFRAME_NUM_PROCESSES", "WORLD_SIZE")

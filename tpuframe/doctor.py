@@ -127,6 +127,21 @@ def telemetry_section() -> dict:
     }
 
 
+def compile_section() -> dict:
+    """State of the compile spine (`tpuframe.compile`): where the
+    persistent compilation cache lives (or would, were it enabled), how
+    many entries / MB it holds, the eviction knobs bounding it, and the
+    ``TPUFRAME_COMPILE_*`` env — so a "slow cold start / slow recovery"
+    report says up front whether warm-start was even on."""
+    from tpuframe.compile.cache import COMPILE_ENV_VARS, cache_info
+
+    info = cache_info()
+    info["env"] = {
+        k: os.environ[k] for k in COMPILE_ENV_VARS if k in os.environ
+    }
+    return info
+
+
 def report(probe_timeout_s: float = 30.0) -> dict:
     """Collect the full environment report (pure data; printing is main's)."""
     import tpuframe
@@ -164,7 +179,10 @@ def report(probe_timeout_s: float = 30.0) -> dict:
                          "cloudpickle", "msgpack")
         },
         "telemetry": telemetry_section(),
-        "compile_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        # the compile section's "dir" supersedes the old env-sourced
+        # compile_cache_dir key: the spine enables the cache via
+        # jax.config, so the env var being unset says nothing
+        "compile": compile_section(),
         "env": {
             k: os.environ[k]
             for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",

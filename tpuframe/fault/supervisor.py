@@ -182,9 +182,33 @@ class Supervisor:
             moved += quarantine_torn_steps(d)
         return moved
 
+    # -- compile warm-start --------------------------------------------------
+    def _ensure_compile_cache(self) -> str | None:
+        """Make sure the persistent compilation cache is live before the
+        first attempt: attempt 1 then *writes* every program it compiles,
+        and an in-process restart (fresh Trainer => fresh traces) or a
+        replacement process on the same host *reads* them back instead of
+        recompiling — the dominant share of the measured recovery wall
+        (bench_fault.py splits it out).  Guarded on jax already being
+        imported: the supervisor itself is stdlib-only and must keep
+        working while jax is wedged; if the training fn imports jax
+        later, ``core.runtime.initialize`` enables the cache then.
+        """
+        import sys
+
+        if "jax" not in sys.modules:
+            return None
+        try:
+            from tpuframe.compile import cache as compile_cache
+
+            return compile_cache.enable_from_env()
+        except Exception:
+            return None  # a broken cache must not block recovery
+
     # -- the loop ------------------------------------------------------------
     def run(self, fn: Callable[[], Any]) -> Any:
         tele = get_telemetry()
+        compile_cache_dir = self._ensure_compile_cache()
         while True:
             quarantined = self.validate_checkpoints()
             if quarantined:
@@ -233,6 +257,10 @@ class Supervisor:
                     budget=budget,
                     delay_s=round(delay, 3),
                     error=repr(e)[:300],
+                    # warm-cache provenance: a restart that recompiled
+                    # from scratch vs one that retrieved its programs is
+                    # the first question a slow-recovery report asks
+                    compile_cache=compile_cache_dir,
                 )
                 logger.warning(
                     "train fn failed (%s, class=%s); restart %d/%d after %.2fs",

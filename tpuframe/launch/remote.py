@@ -227,9 +227,13 @@ class RemoteDistributor:
         # stdin header alone, and a fleet whose ranks silently ran
         # without telemetry cannot be skew-analyzed after the fact
         # (``python -m tpuframe.track analyze`` needs every rank's log).
+        from tpuframe.compile.cache import COMPILE_ENV_VARS
         from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
 
-        for var in OBSERVABILITY_ENV_VARS:
+        # compile-cache knobs ride along for the same reason: a worker
+        # restarted on the same host (or a new rank joining it) must hit
+        # the warm cache the driver configured, not recompile cold
+        for var in OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS:
             if var in os.environ and var not in env:
                 env[var] = os.environ[var]
         env.update(

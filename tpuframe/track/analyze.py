@@ -79,6 +79,12 @@ _ENVELOPE = ("v", "ts", "mono", "rank", "pid", "thread", "kind", "name")
 #: span names that mark checkpoint I/O for boundedness classification
 _CKPT_SPANS = ("ckpt/save", "ckpt/restore", "fault/preempt_checkpoint")
 
+#: records that carry compile wall: AOT spans from the precompiler plus
+#: the cache listener's per-real-compile events (the listener suppresses
+#: its event inside an explicit compile span, so summing both never
+#: double-counts one compile)
+_COMPILE_RECORDS = ("compile/lower", "compile/backend_compile")
+
 
 # -- loading + clock alignment ------------------------------------------------
 
@@ -372,6 +378,46 @@ def _classify(entry: dict, ckpt_wins: list[tuple[float, float]]) -> str:
     return "compute"
 
 
+def _compile_wall(rl: RankLog) -> dict:
+    """Measured compile wall in this rank's log: ``compile/lower`` +
+    ``compile/backend_compile`` spans (the AOT path) and
+    ``compile/backend_compile`` events (implicit runtime compiles, each
+    a real backend compile — persistent-cache hits emit none)."""
+    wall, n = 0.0, 0
+    for rec in rl.events:
+        if rec.get("name") not in _COMPILE_RECORDS:
+            continue
+        try:
+            wall += float(rec.get("dur_s", 0.0))
+        except (TypeError, ValueError):
+            continue
+        n += 1
+    return {"wall_s": round(wall, 6), "records": n}
+
+
+def _time_to_first_step(rl: RankLog) -> float | None:
+    """Seconds from this rank's first telemetry record to the end of its
+    first ``train/step`` span — what a cold start actually cost the rank
+    (loader spin-up, compile, restore, the step itself)."""
+    t0: float | None = None
+    first_step: float | None = None
+    for rec in rl.events:
+        t = rl.end_time(rec)
+        if rec.get("kind") == "span":
+            t -= float(rec.get("dur_s", 0.0))
+        if t0 is None or t < t0:
+            t0 = t
+        if (
+            first_step is None
+            and rec.get("kind") == "span"
+            and rec.get("name") == "train/step"
+        ):
+            first_step = rl.end_time(rec)
+    if t0 is None or first_step is None:
+        return None
+    return max(0.0, first_step - t0)
+
+
 def skew_report(ranks: Sequence[RankLog], *,
                 straggler_factor: float = 1.5,
                 warmup_steps: int = 1) -> dict:
@@ -439,11 +485,34 @@ def skew_report(ranks: Sequence[RankLog], *,
             "p99": round(_pctl(durs, 0.99), 6),
         }
     worst = max(excess, key=lambda r: excess[r]) if excess else None
+    # measured compile wall: the warmup skip exists because the first
+    # step carries the compile — report WHAT it carried instead of
+    # silently dropping it ("first step cost X s of compile")
+    per_rank_compile = {rl.rank: _compile_wall(rl) for rl in ranks}
+    compile_info = {
+        "wall_s": round(
+            sum(c["wall_s"] for c in per_rank_compile.values()), 6
+        ),
+        "records": sum(c["records"] for c in per_rank_compile.values()),
+        "per_rank": {r: c["wall_s"] for r, c in per_rank_compile.items()},
+    }
+    ttfs = {rl.rank: _time_to_first_step(rl) for rl in ranks}
+    ttfs_vals = [t for t in ttfs.values() if t is not None]
     return {
         "ranks": len(ranks),
         "hosts": sorted({rl.hostname for rl in ranks if rl.hostname}),
         "steps": len(per_step),
         "warmup_steps_skipped": max(0, int(warmup_steps)),
+        "compile": compile_info,
+        # the fleet is up when its SLOWEST rank takes its first step —
+        # baseline-diffable like step_time (compile regressions gate)
+        "time_to_first_step": {
+            "s": round(max(ttfs_vals), 6),
+            "per_rank": {
+                r: (None if t is None else round(t, 6))
+                for r, t in ttfs.items()
+            },
+        } if ttfs_vals else None,
         "straggler_factor": straggler_factor,
         "step_time": step_time,          # dispatch-only (baseline diffs)
         "step_wall": {                   # boundary-to-boundary
@@ -491,17 +560,22 @@ def baseline_diff(report: dict, baseline: str, *,
     ``bench_analyze.py`` self-test commits one per backend).
 
     ``ratio_p50 > threshold`` lands the pair in ``regressions``.
-    ``backend`` filters the baselines compared (``"cpu"``/``"tpu"``):
-    without it a CPU run diffed against a results dir that also holds
-    TPU records would read ~10x "slower" and trip the regression exit
-    code spuriously — pass the backend the run actually used (records
-    with no ``backend`` field are always compared).
+    Records carrying a ``time_to_first_step`` block (``bench_compile.py``
+    commits one) diff the same way against the report's measured
+    time-to-first-step — a compile-time regression gates exactly like a
+    step-time regression (exit 3).  ``backend`` filters the baselines
+    compared (``"cpu"``/``"tpu"``): without it a CPU run diffed against
+    a results dir that also holds TPU records would read ~10x "slower"
+    and trip the regression exit code spuriously — pass the backend the
+    run actually used (records with no ``backend`` field are always
+    compared).
     """
     if os.path.isfile(baseline):
         paths = [baseline]
     else:
         paths = sorted(glob.glob(os.path.join(baseline, "*.json")))
     cur = report.get("step_time") or {}
+    cur_ttfs = (report.get("time_to_first_step") or {}).get("s")
     out: dict = {"threshold": threshold, "backend": backend,
                  "baselines": [], "regressions": []}
     for p in paths:
@@ -510,22 +584,32 @@ def baseline_diff(report: dict, baseline: str, *,
                 rec = json.load(f)
         except (OSError, ValueError):
             continue
-        st = rec.get("step_time") if isinstance(rec, dict) else None
-        if not isinstance(st, dict) or not st.get("p50"):
+        if not isinstance(rec, dict):
+            continue
+        st = rec.get("step_time")
+        st = st if isinstance(st, dict) and st.get("p50") else None
+        tt = rec.get("time_to_first_step")
+        tt = tt if isinstance(tt, dict) and tt.get("s") else None
+        if st is None and tt is None:
             continue
         if backend and rec.get("backend") and rec["backend"] != backend:
             continue
-        entry = {
-            "file": os.path.basename(p),
-            "backend": rec.get("backend"),
-            "baseline_p50_s": st["p50"],
-            "current_p50_s": cur.get("p50"),
-        }
-        for q in ("p50", "p95"):
-            if cur.get(q) and st.get(q):
-                entry[f"ratio_{q}"] = round(cur[q] / st[q], 4)
+        entry: dict = {"file": os.path.basename(p),
+                       "backend": rec.get("backend")}
+        if st is not None:
+            entry["baseline_p50_s"] = st["p50"]
+            entry["current_p50_s"] = cur.get("p50")
+            for q in ("p50", "p95"):
+                if cur.get(q) and st.get(q):
+                    entry[f"ratio_{q}"] = round(cur[q] / st[q], 4)
+        if tt is not None and cur_ttfs:
+            entry["baseline_ttfs_s"] = tt["s"]
+            entry["current_ttfs_s"] = cur_ttfs
+            entry["ratio_ttfs"] = round(cur_ttfs / tt["s"], 4)
         out["baselines"].append(entry)
-        if entry.get("ratio_p50") and entry["ratio_p50"] > threshold:
+        if (entry.get("ratio_p50") and entry["ratio_p50"] > threshold) or (
+            entry.get("ratio_ttfs") and entry["ratio_ttfs"] > threshold
+        ):
             out["regressions"].append(entry)
     return out
 
@@ -541,11 +625,30 @@ def format_report(report: dict, diff: dict | None = None, *,
     lines = []
     hosts = f" on {len(report['hosts'])} host(s)" if report.get("hosts") else ""
     warm = report.get("warmup_steps_skipped", 0)
+    comp = report.get("compile") or {}
+    warm_note = ""
+    if warm:
+        warm_note = f" ({warm} warmup/compile step(s) skipped"
+        if comp.get("records"):
+            # the skipped first step's cost, measured, not dropped
+            warm_note += (
+                f"; measured compile wall {comp['wall_s']:.3f}s "
+                f"across {comp['records']} compile record(s)"
+            )
+        warm_note += ")"
     lines.append(
         f"fleet skew report: {report['ranks']} rank(s){hosts}, "
-        f"{report['steps']} step(s)"
-        + (f" ({warm} warmup/compile step(s) skipped)" if warm else "")
+        f"{report['steps']} step(s)" + warm_note
     )
+    ttfs = report.get("time_to_first_step") or {}
+    if ttfs.get("s") is not None:
+        # compile wall is summed fleet-wide (ranks compile in parallel),
+        # so label it that way — printing 8s of compile inside a 3s
+        # startup would read as inconsistent otherwise
+        lines.append(
+            f"  time to first step: {ttfs['s']:.3f}s (slowest rank; "
+            f"fleet compile wall {comp.get('wall_s', 0.0):.3f}s)"
+        )
     st = report.get("step_time") or {}
     if st:
         lines.append(
@@ -603,12 +706,21 @@ def format_report(report: dict, diff: dict | None = None, *,
             verdict = (
                 "REGRESSION" if b in diff["regressions"] else "ok"
             )
-            ratio = b.get("ratio_p50")
+            parts = []
+            if b.get("ratio_p50") is not None:
+                parts.append(
+                    f"p50 {b['baseline_p50_s'] * 1e3:.1f}ms -> "
+                    f"{(b.get('current_p50_s') or 0) * 1e3:.1f}ms "
+                    f"(x{b['ratio_p50']:.2f})"
+                )
+            if b.get("ratio_ttfs") is not None:
+                parts.append(
+                    f"ttfs {b['baseline_ttfs_s']:.3f}s -> "
+                    f"{b['current_ttfs_s']:.3f}s (x{b['ratio_ttfs']:.2f})"
+                )
             lines.append(
                 f"    vs {b['file']} [{b.get('backend')}]: "
-                f"p50 {b['baseline_p50_s'] * 1e3:.1f}ms -> "
-                f"{(b.get('current_p50_s') or 0) * 1e3:.1f}ms "
-                f"(x{ratio:.2f}) {verdict}" if ratio is not None else
+                + " ".join(parts) + f" {verdict}" if parts else
                 f"    vs {b['file']}: incomparable"
             )
     return "\n".join(lines)

@@ -154,6 +154,10 @@ def _wrap_offload(jstep, plan: ParallelPlan | None):
             metrics,
         )
 
+    # the compile spine (tpuframe.compile) AOT-lowers through the inner
+    # jitted program; the wrapper itself stays the call path (its
+    # per-call put-back is host work an executable can't carry)
+    step._inner_jit = jstep
     return step
 
 

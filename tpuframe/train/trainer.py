@@ -22,6 +22,8 @@ interval, not per batch.
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Any, Callable, Mapping, Sequence
 
@@ -30,6 +32,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tpuframe.compile.cache import compile_label
+from tpuframe.compile.precompile import (
+    ShapeGuard,
+    batch_signature,
+    format_signature,
+    loader_batch_template,
+    precompile_step,
+)
 from tpuframe.core import runtime as rt
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
 from tpuframe.fault import chaos
@@ -132,6 +142,17 @@ class Trainer:
         Defaults come from ``TPUFRAME_STRAGGLER_STEPS`` (0 disables;
         else 32) and ``TPUFRAME_STRAGGLER_FACTOR`` (2.0), which launch
         propagates to every worker.
+      precompile: AOT warm-start (``tpuframe.compile``).  ``fit()``
+        derives the train/eval step signatures from the loader specs and
+        lowers+compiles them in a background thread *overlapped with the
+        DataLoader / ring-buffer spin-up*, so first-batch latency is
+        ``max(compile, loader warmup)`` instead of their sum; the hot
+        loop then dispatches straight to the compiled executables (no
+        per-first-step re-trace), and the armed shape guard turns any
+        runtime signature miss into a loud ``compile/recompile`` event.
+        Default None follows ``TPUFRAME_PRECOMPILE`` (on unless set
+        falsy); False opts out.  :meth:`precompile` runs the same thing
+        synchronously on demand.
     """
 
     def __init__(
@@ -168,6 +189,7 @@ class Trainer:
         preempt_sync_steps: int = 16,
         straggler_sync_steps: int | None = None,
         straggler_factor: float | None = None,
+        precompile: bool | None = None,
     ):
         if precision is None:
             # follow the model: an explicitly-bf16 model keeps bf16 compute
@@ -260,6 +282,19 @@ class Trainer:
             img, _ = train_dataloader.dataset[0]
             sample_input = np.asarray(img)[None]
         self.sample_input = sample_input
+
+        if precompile is None:
+            from tpuframe.compile.cache import _FALSY
+
+            v = os.environ.get("TPUFRAME_PRECOMPILE", "").strip().lower()
+            precompile = not v or v not in _FALSY
+        self.precompile_enabled = bool(precompile)
+        # AOT executables keyed by (step kind, batch signature); the
+        # shape guard is armed by precompile with the expected set
+        self._compiled: dict[tuple, Any] = {}
+        self._shape_guard = ShapeGuard()
+        self._precompile_thread: threading.Thread | None = None
+        self._precompile_report: dict | None = None
 
         # live loop state
         self.state: TrainState | None = None
@@ -531,6 +566,126 @@ class Trainer:
             )
         return self.state
 
+    # -- compile warm-start ------------------------------------------------
+    def precompile(self, wait: bool = True) -> dict | None:
+        """AOT-compile the train/eval steps from the loader specs
+        (``tpuframe.compile``): derive each step's full batch signature
+        up front (ragged-tail padding and the grad-accum reshape
+        included), ``lower().compile()`` it under ``compile/lower`` /
+        ``compile/backend_compile`` spans, arm the shape guard with the
+        expected set, and stash the executables for direct dispatch.
+
+        ``fit()`` auto-invokes this with ``wait=False`` so the compile
+        overlaps DataLoader/ring-buffer spin-up; the first step joins.
+        Idempotent; returns the precompile report (signatures + walls).
+        """
+        if self._precompile_thread is None:
+            self.init_state()  # model init on the caller's thread
+            t = threading.Thread(
+                target=self._precompile_run,
+                name="tpuframe-precompile",
+                daemon=True,
+            )
+            self._precompile_thread = t
+            t.start()
+        if wait:
+            self._precompile_thread.join()
+        return self._precompile_report
+
+    def _precompile_run(self) -> None:
+        """Background body: a failed precompile must degrade to today's
+        lazy-compile behavior, never take the fit down."""
+        tele = get_telemetry()
+        report: dict[str, Any] = {"steps": [], "wall_s": 0.0}
+        t0 = time.perf_counter()
+        targets = [("train", self._train_step, True)]
+        if self.eval_dataloader is not None:
+            targets.append(("eval", self._eval_step, False))
+        for kind, fn, train in targets:
+            entry: dict[str, Any] = {"kind": kind}
+            try:
+                template = loader_batch_template(self, train=train)
+                if template is None:
+                    entry["skipped"] = "no derivable loader signature"
+                    report["steps"].append(entry)
+                    continue
+                sig = batch_signature(template)
+                entry["signature"] = format_signature(sig)
+                t1 = time.perf_counter()
+                compiled = precompile_step(
+                    fn, self.state, template, label=f"precompile/{kind}"
+                )
+                entry["wall_s"] = round(time.perf_counter() - t1, 6)
+                # arm the guard even when direct dispatch isn't possible
+                # (offload wrapper): the signature is still the contract,
+                # and the persistent cache is warm for the jit path
+                self._shape_guard.expect(kind, sig)
+                if compiled is not None:
+                    self._compiled[(kind, sig)] = compiled
+                entry["dispatchable"] = compiled is not None
+            except Exception as e:
+                entry["error"] = f"{type(e).__name__}: {e}"[:300]
+                tele.event(
+                    "compile/precompile_error", step_kind=kind,
+                    error=entry["error"],
+                )
+            report["steps"].append(entry)
+        report["wall_s"] = round(time.perf_counter() - t0, 6)
+        self._precompile_report = report
+        tele.event("compile/precompile", **{
+            "wall_s": report["wall_s"],
+            "compiled": sum(
+                1 for s in report["steps"] if s.get("signature")
+            ),
+            "dispatchable": sum(
+                1 for s in report["steps"] if s.get("dispatchable")
+            ),
+        })
+
+    def _step_call(self, kind: str, fn, state, batch):
+        """One step through the compile spine: join an in-flight
+        precompile (first step = ``max(compile, loader warmup)``),
+        dispatch straight to the AOT executable on a signature match,
+        else fall back to the jitted fn with the shape guard shouting
+        about unexpected signatures and the compile label attributing
+        whatever backend compile follows."""
+        tele = get_telemetry()
+        t = self._precompile_thread
+        if t is not None and t.is_alive():
+            with tele.span("compile/wait"):
+                t.join()
+        sig = batch_signature(batch)
+        compiled = self._compiled.get((kind, sig))
+        if compiled is not None:
+            try:
+                return compiled(state, batch)
+            except Exception as e:
+                # sharding/layout drift: drop the executable, shout once,
+                # let the jit path (below) own the call
+                self._compiled.pop((kind, sig), None)
+                tele.event(
+                    "compile/aot_fallback",
+                    step_kind=kind,
+                    signature=format_signature(sig),
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
+                # the train executable donates state: an error raised
+                # AFTER execution launched (OOM, runtime fault) has
+                # already invalidated those buffers, and "retrying" on
+                # deleted arrays would mask the real failure — only
+                # pre-execution rejections (aval/sharding mismatch,
+                # buffers intact) may fall through to the jit path
+                if any(
+                    getattr(x, "is_deleted", lambda: False)()
+                    for x in jax.tree.leaves(state)
+                    if isinstance(x, jax.Array)
+                ):
+                    raise
+        else:
+            self._shape_guard.check(kind, sig)
+        with compile_label(f"{kind} {format_signature(sig)}"):
+            return fn(state, batch)
+
     # -- data --------------------------------------------------------------
     def _device_batches(self, loader: DataLoader, train: bool):
         """Host pipeline: algorithms -> dict batches -> prefetched global arrays."""
@@ -651,6 +806,12 @@ class Trainer:
                 # applied after _run_epoch's set_epoch rewind
                 self._pending_loader_state = restored_meta.get("loader_state")
 
+        if self.precompile_enabled:
+            # background AOT warm-start, overlapped with the epoch's
+            # loader/ring-buffer spin-up; the first _step_call joins.
+            # Started AFTER restore so the lowered programs see the
+            # restored state's exact shardings.
+            self.precompile(wait=False)
         self._log_params(
             {
                 "max_duration": str(self.max_duration),
@@ -811,7 +972,9 @@ class Trainer:
             with tele.span("train/step", batch=self.batches_seen,
                            data_wait_s=round(wait_s, 6)) as sp, \
                     tele.guard("train/step"):
-                self.state, metrics = self._train_step(self.state, batch)
+                self.state, metrics = self._step_call(
+                    "train", self._train_step, self.state, batch
+                )
             dispatch += sp.elapsed
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
@@ -912,7 +1075,7 @@ class Trainer:
         acc = None
         with get_telemetry().span("train/eval", epoch=self.epoch):
             for batch in self._device_batches(self.eval_dataloader, train=False):
-                metrics = self._eval_step(state, batch)
+                metrics = self._step_call("eval", self._eval_step, state, batch)
                 acc = merge_metrics(acc, metrics)
         return summarize_metrics(acc or {}, prefix="eval_")
 
