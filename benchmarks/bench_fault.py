@@ -37,7 +37,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
 
 
-def build_trainer(ds, ckpt, *, snapshot_every, epochs, callbacks=()):
+def build_trainer(ds, ckpt, *, snapshot_every, epochs, callbacks=(), plan=None):
     from tpuframe.data import DataLoader
     from tpuframe.models import MnistNet
     from tpuframe.train import Trainer
@@ -51,6 +51,7 @@ def build_trainer(ds, ckpt, *, snapshot_every, epochs, callbacks=()):
         eval_interval=0,
         log_interval=0,
         callbacks=list(callbacks),
+        plan=plan,
     )
 
 
@@ -257,6 +258,154 @@ def measure_ckpt_stall(workdir: str, args) -> dict:
     return out
 
 
+def measure_shrink(workdir: str, args) -> dict:
+    """Window 3 (``--shrink``): seeded LoseRank kill -> supervised restart
+    at a SMALLER world -> reshard-restore from the topology manifest ->
+    run completes at full step count.  The elastic half of the fault
+    story, measured: recovery wall split (restore *including* the
+    reshard gather/slice, compile of the rebound plan's programs,
+    everything else), ``resume_exact``, and the event proof
+    (``fault/world_resized`` + ``fault/reshard``, zero quarantines)."""
+    import jax
+
+    from tpuframe.ckpt import Checkpointer
+    from tpuframe.ckpt.checkpoint import latest_step
+    from tpuframe.core import MeshSpec
+    from tpuframe.data import SyntheticImageDataset
+    from tpuframe.fault import ChaosPlan, LoseRank, RestartPolicy
+    from tpuframe.launch import run_elastic
+    from tpuframe.parallel import ParallelPlan
+    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.train import Callback
+
+    world_from, world_to = args.shrink_from, args.shrink_to
+    devs = jax.devices()
+    if len(devs) < world_from:
+        raise SystemExit(
+            f"--shrink needs >= {world_from} devices ({len(devs)} visible)"
+        )
+    plan0 = ParallelPlan(
+        mesh=MeshSpec(data=world_from).build(devs[:world_from]),
+        zero_stage=1, min_shard_elems=1,
+    )
+    ds = SyntheticImageDataset(
+        n=16 * args.steps_per_epoch, image_size=28, channels=1,
+        num_classes=4, seed=0,
+    )
+    ckpt_dir = os.path.join(workdir, "shrink_ck")
+    timeline: dict = {"attempt_first_step_t": [], "resume_start_step": [],
+                      "first_step_snap": [], "worlds": []}
+
+    class Probe(Callback):
+        def __init__(self):
+            self.saw_step = False
+
+        def on_fit_start(self, trainer) -> None:
+            self.saw_step = False
+            timeline["resume_start_step"].append(
+                int(jax.device_get(trainer.init_state().step))
+            )
+
+        def on_step_end(self, trainer) -> None:
+            if not self.saw_step:
+                self.saw_step = True
+                timeline["attempt_first_step_t"].append(time.perf_counter())
+                timeline["first_step_snap"].append(_compile_snapshot())
+
+    def train(ctx):
+        timeline["worlds"].append(ctx.world_size)
+        ck = Checkpointer(ckpt_dir)
+        try:
+            tr = build_trainer(
+                ds, ck, snapshot_every=args.snapshot_every,
+                epochs=args.epochs, callbacks=[Probe()], plan=ctx.plan,
+            )
+            res = tr.fit()
+            return int(jax.device_get(tr.state.step)), res
+        finally:
+            ck.close()
+
+    # seeded loss step, strictly after the first snapshot; the lost ranks
+    # are the tail [world_to, world_from) — one "host" taking its chips
+    lost = tuple(range(world_to, world_from))
+    plan = ChaosPlan.scheduled(
+        args.kill_seed,
+        sites={"step": LoseRank(lost)},
+        min_step=args.snapshot_every + 1,
+        max_step=args.steps_per_epoch * args.epochs - 1,
+    )
+    kill_step = plan.injectors[0].step
+    fail_t: list[float] = []
+    fail_snap: list[dict] = []
+    last_ckpt_step: list[int] = []
+
+    def on_restart(attempt_n, error):
+        fail_t.append(time.perf_counter())
+        fail_snap.append(_compile_snapshot())
+        last_ckpt_step.append(max(
+            latest_step(ckpt_dir + "_intra") or 0, latest_step(ckpt_dir) or 0
+        ))
+
+    reg = get_telemetry().registry
+    ev0 = {
+        "reshards": reg.counter("fault/reshards").value,
+        "resizes": reg.counter("fault/world_resizes").value,
+        "quarantined": reg.counter("fault/quarantined_steps").value,
+    }
+    t0 = time.perf_counter()
+    with plan.active():
+        final_step, result = run_elastic(
+            train, plan=plan0,
+            policy=RestartPolicy(max_restarts=2, backoff_base_s=0.0),
+            checkpoint_dir=ckpt_dir,
+            min_world_size=args.min_world_size,
+            on_restart=on_restart,
+        )
+    total_s = time.perf_counter() - t0
+
+    recovery_wall_s = timeline["attempt_first_step_t"][1] - fail_t[0]
+    resumed_step = timeline["resume_start_step"][1]
+    a, b = fail_snap[0], timeline["first_step_snap"][1]
+    restore_s = b["restore"] - a["restore"]
+    compile_s = (b["backend"] - a["backend"]) + (b["lower"] - a["lower"])
+    return {
+        "kill_seed": args.kill_seed,
+        "kill_step": kill_step,
+        "lost_ranks": list(lost),
+        "world_from": world_from,
+        "world_to": world_to,
+        "worlds_per_attempt": timeline["worlds"],
+        "min_world_size": args.min_world_size,
+        "last_ckpt_step": last_ckpt_step[0],
+        "resumed_step": resumed_step,
+        "resume_exact": resumed_step == last_ckpt_step[0],
+        "lost_steps": kill_step - resumed_step,
+        "final_step": final_step,
+        "expected_final_step": args.steps_per_epoch * args.epochs,
+        "recovery_wall_s": round(recovery_wall_s, 3),
+        "recovery_components": {
+            # restore_s INCLUDES the reshard gather/slice: orbax reads
+            # each target shard from the saved layout inside the
+            # ckpt/restore span, so the reshard cost is priced here
+            "restore_incl_reshard_s": round(restore_s, 3),
+            "compile_s": round(compile_s, 3),
+            "other_s": round(
+                max(recovery_wall_s - restore_s - compile_s, 0.0), 3
+            ),
+            "cache_hits": b["hits"] - a["hits"],
+            "cache_misses": b["misses"] - a["misses"],
+        },
+        "reshard_events": reg.counter("fault/reshards").value - ev0["reshards"],
+        "world_resized_events": (
+            reg.counter("fault/world_resizes").value - ev0["resizes"]
+        ),
+        "quarantined_steps": (
+            reg.counter("fault/quarantined_steps").value - ev0["quarantined"]
+        ),
+        "total_wall_s": round(total_s, 3),
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps-per-epoch", type=int, default=8)
@@ -264,7 +413,26 @@ def main(argv=None):
     p.add_argument("--snapshot-every", type=int, default=2)
     p.add_argument("--kill-seed", type=int, default=7)
     p.add_argument("--workdir", default=None)
+    p.add_argument("--shrink", action="store_true",
+                   help="measure the elastic shrink-recovery window "
+                        "(LoseRank kill -> restart at a smaller world -> "
+                        "reshard-restore) instead of the equal-capacity "
+                        "windows")
+    p.add_argument("--shrink-from", type=int, default=4,
+                   help="initial data-parallel world for --shrink")
+    p.add_argument("--shrink-to", type=int, default=2,
+                   help="surviving world for --shrink")
+    p.add_argument("--min-world-size", type=int, default=2)
     args = p.parse_args(argv)
+
+    if args.shrink and os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # the shrink window needs a multi-device world; explicit CPU runs
+        # (CI, capture ladder's CPU fallback) get the test suite's
+        # simulated mesh, armed BEFORE the backend initializes.  TPU
+        # hosts use their real chips.
+        from tpuframe.core.runtime import simulate_cpu_devices
+
+        simulate_cpu_devices(max(args.shrink_from, 8))
 
     import tempfile
 
@@ -274,6 +442,30 @@ def main(argv=None):
 
     from tpuframe.core import runtime as rt
     from tpuframe.compile import cache as compile_cache
+
+    if args.shrink:
+        # shipped-default conditions: warm persistent compile cache (the
+        # restart's programs for the REBOUND plan are new lowerings, so
+        # the split shows real compile, not retrieval — that is the
+        # honest reshard-recovery price)
+        warm_dir = tempfile.mkdtemp(prefix="tpuframe_bf_cache_")
+        os.environ["TPUFRAME_COMPILE_CACHE"] = warm_dir
+        compile_cache.enable(warm_dir)
+        shrink = measure_shrink(workdir, args)
+        print(json.dumps({
+            "metric": "fault_shrink_recovery_wall_s",
+            "value": shrink["recovery_wall_s"],
+            "unit": ("seconds from injected rank loss to first completed "
+                     "step at the SHRUNKEN world (supervisor probe + mesh "
+                     "rebuild + plan rebind + reshard-restore + rebound-"
+                     "plan compile + step; MnistNet 28px b16, dp "
+                     f"{shrink['world_from']}->{shrink['world_to']}, "
+                     f"{jax.default_backend()})"),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "shrink": shrink,
+        }))
+        return
 
     # recovery is measured twice: a COLD window (persistent compile
     # cache off — the pre-compile-spine behavior, attempt 2 pays a full

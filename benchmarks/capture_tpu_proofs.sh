@@ -144,6 +144,13 @@ run bench_e2e_tpu_uint8.json   900  python benchmarks/bench_e2e.py --uint8-input
 # win (FAULT.md); cheap, so it rides above the long tail
 run bench_fault.json           300  python benchmarks/bench_fault.py
 
+# elastic shrink rung: seeded rank loss -> supervised restart at a
+# SMALLER world -> reshard-restore from the topology manifest — on the
+# TPU host this prices the real cross-chip reshard gather + the rebound
+# plan's compile (FAULT.md "Elastic recovery"); rides with the fault
+# rung above the long tail
+run bench_fault_shrink.json    300  python benchmarks/bench_fault.py --shrink
+
 # fleet-analysis rung: an instrumented fit analyzes its own telemetry
 # (cross-rank merge -> skew table -> Perfetto trace) and commits the
 # on-chip step_time block that `python -m tpuframe.track analyze

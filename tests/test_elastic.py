@@ -1,0 +1,633 @@
+"""Elastic topology-shifting recovery acceptance: topology manifests,
+reshard-on-restore, plan rebind, shrink-to-survivors supervision,
+min-world giveup, bounded fleet gathers, doctor manifest reporting.
+
+The ISSUE-6 acceptance path, all on the 8-virtual-device CPU mesh:
+seeded chaos kill of rank(s) -> supervised restart at a smaller world
+-> restore reshards from the manifest -> training continues bit-exact
+at the restore boundary and completes the full schedule."""
+
+import os
+import warnings
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.ckpt import Checkpointer, read_manifest, topology_manifest
+from tpuframe.core import MeshSpec
+from tpuframe.data import DataLoader, SyntheticImageDataset
+from tpuframe.fault import (
+    ChaosPlan,
+    LoseRank,
+    RankLostError,
+    RestartPolicy,
+    Supervisor,
+    WorldTooSmall,
+    chaos,
+    lost_ranks,
+)
+from tpuframe.launch import rederive_batch_split, run_elastic
+from tpuframe.models import MnistNet
+from tpuframe.parallel import ParallelPlan
+from tpuframe.track.telemetry import get_telemetry
+from tpuframe.train import Callback, Trainer, create_train_state
+
+
+_MARKS = iter(range(1, 1 << 30))
+
+
+def _mark() -> str:
+    """Drop a marker event into the bounded telemetry ring; events
+    'since' are everything after it (index math would break on wrap)."""
+    token = f"elastic-test-{next(_MARKS)}"
+    get_telemetry().event("test/mark", token=token)
+    return token
+
+
+def _events_since(token: str, name: str | None = None) -> list[dict]:
+    ev = get_telemetry().recent_events(10**6)
+    idx = max(
+        i for i, e in enumerate(ev)
+        if e.get("name") == "test/mark" and e.get("token") == token
+    )
+    return [e for e in ev[idx + 1:] if name is None or e.get("name") == name]
+
+
+def _mesh(dp: int, **axes):
+    devs = jax.devices()
+    spec = MeshSpec(data=dp, **axes)
+    n = int(np.prod([max(s, 1) for s in spec.sizes().values()]))
+    return spec.build(devs[:n])
+
+
+def _tiny_state(plan, seed=0):
+    import jax.numpy as jnp
+
+    return create_train_state(
+        MnistNet(num_classes=4),
+        jax.random.PRNGKey(seed),
+        jnp.ones((1, 28, 28, 1)),
+        optax.adam(1e-3),
+        plan=plan,
+        init_kwargs={"train": False},
+    )
+
+
+def _host_tree(tree):
+    # np.array(copy=True), not np.asarray: on the CPU backend device_get
+    # can hand back a zero-copy VIEW of the XLA buffer, and the donating
+    # train step would overwrite a captured "snapshot" in place
+    return jax.tree.map(lambda x: np.array(jax.device_get(x), copy=True), tree)
+
+
+def _assert_trees_bit_exact(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- topology manifests -------------------------------------------------------
+
+
+class TestManifest:
+    def test_save_embeds_manifest(self, tmp_path):
+        plan = ParallelPlan(mesh=_mesh(4), zero_stage=1, min_shard_elems=1)
+        state = _tiny_state(plan)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(state, step=3, plan=plan)
+            ck.wait()
+            man = ck.manifest_for()
+        assert man is not None
+        assert man["mesh_axes"]["data"] == 4
+        assert man["world_size"] == 4
+        assert man["plan_signature"] == plan.signature()
+        assert man["zero_stage"] == 1
+        # per-leaf logical specs recorded (global shapes + partition spec)
+        assert len(man["leaves"]) == len(
+            jax.tree.leaves(
+                {"p": state.params, "o": state.opt_state, "s": state.step,
+                 "r": state.rng}
+            )
+        ) + len(jax.tree.leaves(state.batch_stats))
+        any_leaf = next(iter(man["leaves"].values()))
+        assert set(any_leaf) == {"shape", "dtype", "spec"}
+
+    def test_numpy_state_has_no_manifest(self, tmp_path):
+        d = str(tmp_path / "ck")
+        with Checkpointer(d) as ck:
+            ck.save({"w": np.arange(4, dtype=np.float32)}, step=1)
+            ck.wait()
+        assert read_manifest(d) is None  # host pytree: topology-free
+
+    def test_topology_manifest_direct(self):
+        plan = ParallelPlan(mesh=_mesh(2), zero_stage=0)
+        state = _tiny_state(plan)
+        man = topology_manifest(state, plan)
+        assert man["world_size"] == 2 and man["version"] == 1
+
+    def test_read_manifest_missing_dir(self, tmp_path):
+        assert read_manifest(str(tmp_path / "nope")) is None
+
+
+# -- reshard-on-restore (the tentpole's ckpt half) ---------------------------
+
+
+class TestReshardRestore:
+    @pytest.mark.parametrize("target_dp", [2, 1])
+    def test_save_dp4_restore_smaller_bit_exact(self, tmp_path, target_dp):
+        """Save under dp=4 ZeRO-1, restore under dp=2/dp=1: params AND
+        optimizer state bit-exact vs the gather reference, identical
+        forward logits, one fault/reshard event."""
+        plan4 = ParallelPlan(mesh=_mesh(4), zero_stage=1, min_shard_elems=1)
+        state = _tiny_state(plan4)
+        ref = _host_tree(
+            {"params": state.params, "opt": state.opt_state,
+             "stats": state.batch_stats}
+        )
+        x = np.random.default_rng(0).random((4, 28, 28, 1)).astype(np.float32)
+        ref_logits = np.asarray(state.apply_fn({"params": state.params}, x,
+                                               train=False))
+        d = str(tmp_path / "ck")
+        with Checkpointer(d) as ck:
+            ck.save(state, step=7, plan=plan4)
+            ck.wait()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # dp=1 collapse warning
+                plan = plan4.rebind(_mesh(target_dp))
+            template = _tiny_state(plan, seed=9)  # different init: must be overwritten
+            n0 = _mark()
+            restored, _ = ck.restore(template, plan=plan)
+        got = _host_tree(
+            {"params": restored.params, "opt": restored.opt_state,
+             "stats": restored.batch_stats}
+        )
+        _assert_trees_bit_exact(ref, got)
+        # restored leaves actually live on the TARGET mesh
+        leaf = jax.tree.leaves(restored.params)[0]
+        assert dict(leaf.sharding.mesh.shape)["data"] == target_dp
+        logits = np.asarray(restored.apply_fn({"params": restored.params}, x,
+                                              train=False))
+        np.testing.assert_array_equal(ref_logits, logits)
+        ev = _events_since(n0, "fault/reshard")
+        assert len(ev) == 1
+        assert ev[0]["from_world"] == 4 and ev[0]["to_world"] == target_dp
+        assert ev[0]["from_axes"]["data"] == 4
+
+    def test_same_topology_restore_emits_no_reshard(self, tmp_path):
+        plan = ParallelPlan(mesh=_mesh(2))
+        state = _tiny_state(plan)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(state, step=1, plan=plan)
+            ck.wait()
+            n0 = _mark()
+            ck.restore(_tiny_state(plan, seed=1))
+        assert _events_since(n0, "fault/reshard") == []
+
+    def test_logical_mismatch_raises_before_read(self, tmp_path):
+        """A different MODEL is not a different mesh: global shape
+        mismatch must raise loudly, not limp into a partial orbax read."""
+        plan4 = ParallelPlan(mesh=_mesh(4))
+        state = _tiny_state(plan4)
+        d = str(tmp_path / "ck")
+        with Checkpointer(d) as ck:
+            ck.save(state, step=1, plan=plan4)
+            ck.wait()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # data-axis collapse
+                plan1 = plan4.rebind(_mesh(1))
+            import jax.numpy as jnp
+
+            other = create_train_state(
+                MnistNet(num_classes=7),  # different head width
+                jax.random.PRNGKey(0), jnp.ones((1, 28, 28, 1)),
+                optax.adam(1e-3), plan=plan1, init_kwargs={"train": False},
+            )
+            with pytest.raises(ValueError, match="different model"):
+                ck.restore(other, plan=plan1)
+
+
+# -- plan rebind + signature --------------------------------------------------
+
+
+class TestPlanRebind:
+    def test_signature_stable_and_topology_sensitive(self):
+        plan_a = ParallelPlan(mesh=_mesh(4), zero_stage=1)
+        plan_b = ParallelPlan(mesh=_mesh(4), zero_stage=1)
+        assert plan_a.signature() == plan_b.signature()
+        assert plan_a.signature() != ParallelPlan(
+            mesh=_mesh(2), zero_stage=1
+        ).signature()
+        assert plan_a.signature() != ParallelPlan(
+            mesh=_mesh(4), zero_stage=3
+        ).signature()
+
+    def test_rebind_keeps_policy_and_emits_event(self):
+        plan = ParallelPlan(mesh=_mesh(4), zero_stage=1, min_shard_elems=1)
+        n0 = _mark()
+        rebound = plan.rebind(_mesh(2))
+        assert rebound.zero_stage == 1 and rebound.min_shard_elems == 1
+        assert rebound.dp_size == 2
+        ev = _events_since(n0, "parallel/plan_rebind")
+        assert len(ev) == 1
+        assert ev[0]["from_world"] == 4 and ev[0]["to_world"] == 2
+        assert ev[0]["collapsed"] == []
+        assert ev[0]["signature"] == rebound.signature()
+
+    def test_rebind_axis_collapse_is_loud(self):
+        plan = ParallelPlan(
+            mesh=MeshSpec(data=2, fsdp=2).build(jax.devices()[:4]),
+            zero_stage=1, min_shard_elems=1,
+        )
+        n0 = _mark()
+        with pytest.warns(UserWarning, match="collapsed mesh axis"):
+            rebound = plan.rebind(_mesh(2))
+        ev = _events_since(n0, "parallel/plan_rebind")
+        assert ev[0]["collapsed"] == ["fsdp"]
+        assert rebound.dp_size == 2
+
+    def test_shrink_to_rejects_broken_fixed_axes(self):
+        mesh = MeshSpec(data=2, model=2).build(jax.devices()[:4])
+        spec = MeshSpec.from_mesh(mesh)
+        assert spec.model == 2 and spec.data == 2
+        with pytest.raises(ValueError, match="multiple of 2"):
+            spec.shrink_to(3)  # 3 survivors can't keep model=2
+        assert spec.shrink_to(2).sizes()["model"] == 2
+
+
+# -- LoseRank chaos -----------------------------------------------------------
+
+
+class TestLoseRank:
+    def test_fires_at_step_registers_and_raises(self):
+        inj = LoseRank((2, 3), 5)
+        plan = ChaosPlan([inj])
+        with plan.active():
+            chaos.maybe_fire("step", step=4)  # not yet
+            assert lost_ranks() == frozenset()
+            with pytest.raises(RankLostError, match=r"rank\(s\) \[2, 3\]"):
+                chaos.maybe_fire("step", step=5)
+            assert lost_ranks() == frozenset({2, 3})
+            chaos.maybe_fire("step", step=5)  # budget spent
+            assert plan.fired_count() == 1
+        # world damage is plan-scoped
+        assert lost_ranks() == frozenset()
+
+    def test_seeded_schedule_determinism(self):
+        a = ChaosPlan.scheduled(11, max_step=50, sites={"step": LoseRank(1)})
+        b = ChaosPlan.scheduled(11, max_step=50, sites={"step": LoseRank(1)})
+        assert a.injectors[0].step == b.injectors[0].step
+        assert isinstance(a.injectors[0], LoseRank)
+
+    def test_classified_retryable(self):
+        from tpuframe.fault import FailureClass, classify_failure
+
+        assert classify_failure(RankLostError("gone")) is FailureClass.RETRYABLE
+
+
+# -- shrink-to-survivors supervision -----------------------------------------
+
+
+def _ds(n=64):
+    return SyntheticImageDataset(
+        n=n, image_size=28, channels=1, num_classes=4, seed=0
+    )
+
+
+def _elastic_trainer(ds, ck, ctx_plan, callbacks=()):
+    return Trainer(
+        MnistNet(num_classes=4),
+        train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=3),
+        max_duration="2ep",
+        eval_interval=0,
+        log_interval=0,
+        checkpointer=ck,
+        checkpoint_interval_batches=2,
+        plan=ctx_plan,
+        callbacks=list(callbacks),
+    )
+
+
+@pytest.mark.chaos
+def test_supervised_shrink_resumes_bit_exact_and_completes(tmp_path):
+    """THE acceptance story: seeded LoseRank kill -> supervised restart at
+    world 2 -> reshard-restore from the manifest -> bit-exact at the
+    boundary -> full step count, zero quarantined steps."""
+    ds = _ds()
+    ckpt_dir = str(tmp_path / "ck")
+    plan4 = ParallelPlan(mesh=_mesh(4), zero_stage=1, min_shard_elems=1)
+    worlds, resume_params, resume_steps, results = [], [], [], []
+
+    class Rec(Callback):
+        def on_fit_start(self, trainer):
+            resume_steps.append(int(jax.device_get(trainer.init_state().step)))
+            resume_params.append(_host_tree(
+                {"p": trainer.state.params, "o": trainer.state.opt_state}
+            ))
+
+    boundary_ref = []
+
+    def train(ctx):
+        worlds.append(ctx.world_size)
+        if ctx.resized:
+            # gather reference AT the boundary, from whichever source the
+            # trainer's auto-resume will pick (mid-epoch snapshot when
+            # newer, else the epoch-end checkpoint), read back at the
+            # ORIGINAL topology — while it still exists (retention prunes)
+            from tpuframe.ckpt import latest_step
+
+            intra_dir = ckpt_dir + "_intra"
+            src = (
+                intra_dir
+                if (latest_step(intra_dir) or -1) > (latest_step(ckpt_dir) or -1)
+                else ckpt_dir
+            )
+            with Checkpointer(src) as source:
+                ref, _ = source.restore(_tiny_state(plan4, seed=9), plan=plan4)
+            boundary_ref.append(_host_tree({"p": ref.params, "o": ref.opt_state}))
+        ck = Checkpointer(ckpt_dir)
+        try:
+            tr = _elastic_trainer(ds, ck, ctx.plan, callbacks=[Rec()])
+            res = tr.fit()
+            results.append((tr, res))
+            return tr, res
+        finally:
+            ck.close()
+
+    kill_step = 5  # mid epoch 2 (4 steps/epoch), after snapshots exist
+    n0 = _mark()
+    with ChaosPlan([LoseRank((2, 3), kill_step)]).active():
+        tr, res = run_elastic(
+            train, plan=plan4,
+            policy=RestartPolicy(max_restarts=2, backoff_base_s=0.0),
+            checkpoint_dir=ckpt_dir, min_world_size=2,
+        )
+
+    assert res.error is None
+    assert worlds == [4, 2]
+    # resumed exactly at the last even-step snapshot before the kill
+    assert resume_steps == [0, kill_step - kill_step % 2]
+    assert int(jax.device_get(tr.state.step)) == 8  # 2ep x 4 steps, nothing lost
+    # bit-exact at the restore boundary: attempt 2's resume state equals
+    # the snapshot read back at the ORIGINAL topology (gather reference)
+    assert len(boundary_ref) == 1
+    _assert_trees_bit_exact(boundary_ref[0], resume_params[1])
+    # events: one resize 4->2, one reshard into the survivor mesh, and
+    # NO quarantine (a shrink is not a torn checkpoint)
+    resized = _events_since(n0, "fault/world_resized")
+    assert len(resized) == 1
+    assert resized[0]["from_world"] == 4 and resized[0]["to_world"] == 2
+    reshards = _events_since(n0, "fault/reshard")
+    assert len(reshards) >= 1 and reshards[0]["to_world"] == 2
+    assert _events_since(n0, "fault/quarantine") == []
+    # the restarted attempt saw no unexpected signatures (the rebound
+    # plan's programs are its OWN expected set, not recompiles)
+    assert _events_since(n0, "compile/recompile") == []
+
+
+@pytest.mark.chaos
+def test_supervised_shrink_matches_uninterrupted_loss(tmp_path):
+    """The shrunk continuation trains on the SAME global batches: its
+    final loss matches an uninterrupted equal-schedule run (same data
+    order, same augmentation draws) to float tolerance."""
+    ds = _ds()
+    plan4 = ParallelPlan(mesh=_mesh(4), zero_stage=1, min_shard_elems=1)
+
+    # reference: uninterrupted 2-epoch fit at full capacity
+    ck_ref = Checkpointer(str(tmp_path / "ref"))
+    try:
+        res_ref = _elastic_trainer(ds, ck_ref, plan4).fit()
+    finally:
+        ck_ref.close()
+
+    ckpt_dir = str(tmp_path / "ck")
+
+    def train(ctx):
+        ck = Checkpointer(ckpt_dir)
+        try:
+            tr = _elastic_trainer(ds, ck, ctx.plan)
+            return tr.fit()
+        finally:
+            ck.close()
+
+    with ChaosPlan([LoseRank((2, 3), 5)]).active():
+        res = run_elastic(
+            train, plan=plan4,
+            policy=RestartPolicy(max_restarts=2, backoff_base_s=0.0),
+            checkpoint_dir=ckpt_dir, min_world_size=1,
+        )
+    assert res.error is None
+    # same data order, same stateless augmentation draws, same global
+    # batch: only the reduction layout changed, so loss parity is float
+    # tolerance, not luck
+    np.testing.assert_allclose(
+        res.metrics["train_loss"], res_ref.metrics["train_loss"],
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_min_world_size_giveup(tmp_path):
+    """Survivors below the floor: fault/giveup(min-world-size) +
+    WorldTooSmall, not an endless equal-capacity retry loop."""
+    calls = []
+
+    def fn(world):
+        calls.append(world)
+        raise RankLostError("peers gone")
+
+    probes = iter([4, 1, 1, 1])
+    n0 = _mark()
+    sup = Supervisor(
+        RestartPolicy(max_restarts=5, backoff_base_s=0.0),
+        capacity_probe=lambda: next(probes),
+        min_world_size=2,
+    )
+    with pytest.raises(WorldTooSmall, match="min_world_size=2"):
+        sup.run(fn)
+    assert calls == [4]  # attempt 2 never ran: the probe said 1 < 2
+    giveups = _events_since(n0, "fault/giveup")
+    assert giveups and giveups[-1]["reason"] == "min-world-size"
+    assert giveups[-1]["world_size"] == 1
+
+
+def test_grow_beyond_base_plan_refuses():
+    """A probe reporting MORE devices than the base mesh spans must fail
+    loudly — silently building a smaller mesh than fault/world_resized
+    announced would desync world_size from the actual dp split."""
+    plan2 = ParallelPlan(mesh=_mesh(2))
+    probes = iter([2, 8, 8])
+    attempts = []
+
+    def fn(ctx):
+        attempts.append(ctx.world_size)
+        raise RankLostError("first attempt dies")
+
+    with pytest.raises(ValueError, match="larger device set"):
+        run_elastic(
+            fn, plan=plan2,
+            policy=RestartPolicy(max_restarts=3, backoff_base_s=0.0),
+            capacity_probe=lambda: next(probes),
+        )
+    assert attempts == [2]  # the bogus grow never reached the train fn
+
+
+def test_elastic_restart_rearms_fleet_gather():
+    """A (re)started attempt runs on a (re)built world: the sticky
+    peer-lost degradation from the BROKEN world must not survive it."""
+    from tpuframe.track import analyze
+
+    analyze._FLEET_DEGRADED = True
+    try:
+        seen = []
+
+        def fn(ctx):
+            seen.append(analyze.fleet_degraded())
+            return "ok"
+
+        assert run_elastic(fn, plan=ParallelPlan(mesh=_mesh(2))) == "ok"
+        assert seen == [False]
+    finally:
+        analyze.reset_fleet_degraded()
+
+
+def test_supervisor_without_probe_keeps_zero_arg_contract():
+    sup = Supervisor(RestartPolicy(backoff_base_s=0.0))
+    assert sup.run(lambda: "ok") == "ok"
+    assert sup.world_size is None
+
+
+def test_rederive_batch_split_preserves_global_batch():
+    # same split when it still divides
+    out = rederive_batch_split(256, dp_size=8, grad_accum=2)
+    assert out == {"global_batch": 256, "local_batch": 256,
+                   "grad_accum": 2, "micro_batch": 16}
+    # dp no longer divides the microbatch -> nearest divisor grad_accum
+    out = rederive_batch_split(96, dp_size=16, grad_accum=4)
+    assert out["global_batch"] == 96
+    assert (96 // out["grad_accum"]) % 16 == 0
+    # impossible: global batch not a multiple of dp
+    with pytest.raises(ValueError, match="no grad-accum split"):
+        rederive_batch_split(10, dp_size=4)
+    # shrink across processes
+    out = rederive_batch_split(64, dp_size=2, process_count=2)
+    assert out["local_batch"] == 32
+
+
+def test_trainer_rejects_changed_global_batch_on_restore(tmp_path):
+    """The data-order guard: resuming with a different GLOBAL batch is a
+    misconfiguration (the checkpointed loader position would lie), FATAL
+    by classification."""
+    ds = _ds(n=32)
+    ckpt_dir = str(tmp_path / "ck")
+    plan = ParallelPlan(mesh=_mesh(2))
+    with Checkpointer(ckpt_dir) as ck:
+        tr = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=DataLoader(ds, batch_size=16, shuffle=True, seed=3),
+            max_duration="1ep", eval_interval=0, log_interval=0,
+            checkpointer=ck, plan=plan,
+        )
+        tr.fit()
+    with Checkpointer(ckpt_dir) as ck:
+        tr2 = Trainer(
+            MnistNet(num_classes=4),
+            train_dataloader=DataLoader(ds, batch_size=8, shuffle=True, seed=3),
+            max_duration="2ep", eval_interval=0, log_interval=0,
+            checkpointer=ck, plan=plan,
+        )
+        with pytest.raises(ValueError, match="global batch"):
+            tr2.fit()
+
+
+# -- bounded fleet gather (fault/peer_lost) -----------------------------------
+
+
+class TestBoundedFleetGather:
+    @pytest.fixture(autouse=True)
+    def _rearm(self):
+        from tpuframe.track import analyze
+
+        analyze.reset_fleet_degraded()
+        yield
+        analyze.reset_fleet_degraded()
+
+    def test_timeout_degrades_to_local_with_event(self, monkeypatch):
+        import time as _time
+
+        from tpuframe.track import analyze
+
+        monkeypatch.setattr(
+            analyze, "_gather_values", lambda v: _time.sleep(30) or [v]
+        )
+        n0 = _mark()
+        out = analyze._bounded_gather(3.0, timeout_s=0.05)
+        assert out == [3.0]
+        assert analyze.fleet_degraded()
+        ev = _events_since(n0, "fault/peer_lost")
+        assert len(ev) == 1 and ev[0]["degraded_to"] == "local"
+        # sticky: the next call never re-enters the wedged collective
+        assert analyze.fleet_allgather(5.0) == [5.0]
+
+    def test_gather_error_also_degrades(self, monkeypatch):
+        from tpuframe.track import analyze
+
+        def boom(v):
+            raise RuntimeError("peer unreachable")
+
+        monkeypatch.setattr(analyze, "_gather_values", boom)
+        n0 = _mark()
+        assert analyze._bounded_gather(1.0, timeout_s=5.0) == [1.0]
+        ev = _events_since(n0, "fault/peer_lost")
+        assert "peer unreachable" in ev[0]["error"]
+
+    def test_fast_gather_passes_through(self, monkeypatch):
+        from tpuframe.track import analyze
+
+        monkeypatch.setattr(analyze, "_gather_values", lambda v: [v, v + 1])
+        assert analyze._bounded_gather(1.0, timeout_s=5.0) == [1.0, 2.0]
+        assert not analyze.fleet_degraded()
+
+    def test_agree_still_works_degraded(self):
+        from tpuframe.fault.preempt import agree
+        from tpuframe.track import analyze
+
+        analyze._FLEET_DEGRADED = True
+        assert agree(True) is True and agree(False) is False
+
+
+# -- doctor manifest reporting ------------------------------------------------
+
+
+class TestDoctorCkptSection:
+    def test_reports_topology_and_mismatch_warning(self, tmp_path):
+        from tpuframe.doctor import ckpt_section
+
+        plan = ParallelPlan(mesh=_mesh(4), zero_stage=1, min_shard_elems=1)
+        state = _tiny_state(plan)
+        d = str(tmp_path / "ck")
+        with Checkpointer(d) as ck:
+            ck.save(state, step=2, plan=plan)
+            ck.wait()
+        sec = ckpt_section(d, device_count=4)
+        assert sec["latest_step"] == 2
+        assert sec["topology"]["world_size"] == 4
+        assert sec["topology"]["mesh_axes"]["data"] == 4
+        assert sec["topology"]["plan_signature"] == plan.signature()
+        assert "warning" not in sec
+        # current backend smaller than the saved world -> reshard one-liner
+        sec = ckpt_section(d, device_count=2)
+        assert "rebind" in sec["warning"]
+
+    def test_none_without_directory(self, monkeypatch):
+        from tpuframe.doctor import ckpt_section
+
+        monkeypatch.delenv("TPUFRAME_CKPT_DIR", raising=False)
+        assert ckpt_section(None) is None
+
+    def test_empty_directory(self, tmp_path):
+        from tpuframe.doctor import ckpt_section
+
+        sec = ckpt_section(str(tmp_path))
+        assert sec["latest_step"] is None and sec["quarantined"] == []

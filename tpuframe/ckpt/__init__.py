@@ -21,7 +21,9 @@ from tpuframe.ckpt.checkpoint import (
     latest_step,
     load_pytree,
     quarantine_torn_steps,
+    read_manifest,
     save_pytree,
+    topology_manifest,
     valid_steps,
 )
 
@@ -32,6 +34,8 @@ __all__ = [
     "latest_step",
     "load_pytree",
     "quarantine_torn_steps",
+    "read_manifest",
     "save_pytree",
+    "topology_manifest",
     "valid_steps",
 ]

@@ -7,6 +7,18 @@ directly onto whatever mesh/sharding the restoring process is running —
 resharding across different device counts is free (orbax reads each shard of
 the target sharding from disk).
 
+Every committed step is additionally **topology-portable** by contract:
+``save`` embeds a topology manifest (mesh axis names/sizes, world size,
+``ParallelPlan`` signature, per-leaf logical shape + partition spec) in the
+step's meta JSON, and ``restore`` compares it against the *target* topology
+(the template's shardings, or an explicit ``plan=``).  On mismatch the
+restore **reshards at load** — each leaf is gathered-or-sliced from the
+saved partition layout into the target ``param_spec``/``state_spec``
+(ZeRO/FSDP optimizer shards re-partitioned, replicated leaves broadcast),
+one loud ``fault/reshard`` event marking the boundary — which is what lets
+the fault supervisor restart a run at a *smaller* world size instead of
+waiting for equal capacity (FAULT.md "Elastic recovery").
+
 Replaces the reference's ``torch.save``/``load_checkpoint(epoch)`` pair
 (`/root/reference/01_torch_distributor/01_basic_torch_distributor.py:109-124`)
 and its DDP ``.module.state_dict()`` unwrap (`:239-245`) — there is no wrapper
@@ -117,6 +129,156 @@ def quarantine_torn_steps(directory: str | os.PathLike) -> list[str]:
     return moved
 
 
+# -- topology manifests -------------------------------------------------------
+
+
+def topology_manifest(state: Any, plan: Any = None) -> dict | None:
+    """The topology manifest of a live state: mesh axes/world size read off
+    the leaves' own ``NamedSharding``s (no plan required — the arrays know
+    where they live), per-leaf logical (global) shape/dtype/PartitionSpec,
+    plus the plan's stable signature when one is supplied.  None for states
+    with no mesh-sharded leaf (host numpy pytrees) — those are
+    topology-free already."""
+    from tpuframe.parallel.sharding import mesh_axes, path_str, spec_to_json
+
+    mesh = None
+    leaves: dict[str, dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(_state_data(state))[0]:
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        leaf_mesh = getattr(sharding, "mesh", None)
+        if spec is None or leaf_mesh is None or not hasattr(leaf_mesh, "devices"):
+            continue
+        mesh = mesh if mesh is not None else leaf_mesh
+        leaves[path_str(path)] = {
+            "shape": [int(d) for d in leaf.shape],
+            "dtype": np.dtype(leaf.dtype).name,
+            "spec": spec_to_json(spec),
+        }
+    if mesh is None:
+        return None
+    return {
+        "version": 1,
+        "mesh_axes": mesh_axes(mesh),
+        "world_size": int(mesh.devices.size),
+        "process_count": int(jax.process_count()),
+        "plan_signature": plan.signature() if plan is not None else None,
+        "zero_stage": getattr(plan, "zero_stage", None),
+        "leaves": leaves,
+    }
+
+
+def read_manifest(directory: str | os.PathLike, step: int | None = None) -> dict | None:
+    """The topology manifest of ``step`` (default: latest committed), read
+    straight off the on-disk meta JSON — stdlib-only, so the doctor can
+    print it without touching orbax or a possibly-wedged backend.  None
+    for pre-manifest checkpoints or when no committed step exists."""
+    if step is None:
+        step = latest_step(directory)
+    if step is None:
+        return None
+    path = os.path.join(os.fspath(directory), str(step), "meta", "metadata")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (FileNotFoundError, NotADirectoryError, IsADirectoryError, ValueError):
+        return None
+    return doc.get("topology") if isinstance(doc, dict) else None
+
+
+def _target_topology(abstract: Any) -> dict | None:
+    """Mesh axes/world of the restore *target*, read off the abstract
+    template's shardings (the first mesh-sharded leaf wins — one state,
+    one mesh)."""
+    from tpuframe.parallel.sharding import mesh_axes
+
+    for leaf in jax.tree.leaves(abstract):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if getattr(sharding, "spec", None) is not None and hasattr(mesh, "devices"):
+            return {
+                "mesh_axes": mesh_axes(mesh),
+                "world_size": int(mesh.devices.size),
+            }
+    return None
+
+
+def _validate_manifest_compat(manifest: dict, abstract: Any) -> None:
+    """A reshard is only legal between topologies of the SAME logical
+    state: the manifest records global leaf shapes, which are
+    topology-independent, so any shape/dtype mismatch means a different
+    model/optimizer — raise loudly instead of letting orbax fail halfway
+    through a partial read."""
+    from tpuframe.parallel.sharding import path_str
+
+    current = {
+        path_str(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(abstract)[0]
+    }
+    mismatched = []
+    for path, rec in (manifest.get("leaves") or {}).items():
+        leaf = current.get(path)
+        if leaf is None or not hasattr(leaf, "shape"):
+            continue
+        if (
+            [int(d) for d in leaf.shape] != list(rec["shape"])
+            or np.dtype(leaf.dtype).name != rec["dtype"]
+        ):
+            mismatched.append(
+                f"{path}: saved {rec['shape']}/{rec['dtype']} vs target "
+                f"{[int(d) for d in leaf.shape]}/{np.dtype(leaf.dtype).name}"
+            )
+    if mismatched:
+        raise ValueError(
+            "checkpoint cannot reshard onto the target topology: global "
+            "leaf shapes/dtypes differ (logical shapes are "
+            "topology-independent, so this is a different model/optimizer, "
+            "not a different mesh): " + "; ".join(mismatched[:5])
+            + (f" (+{len(mismatched) - 5} more)" if len(mismatched) > 5 else "")
+        )
+
+
+def _apply_plan_shardings(abstract: Any, plan: Any) -> Any:
+    """Override the abstract template's shardings with plan-derived ones
+    (``param_spec``/``state_spec``) — the explicit target-plan restore
+    path.  TrainState-shaped templates route params/batch_stats through
+    ``param_shardings`` and opt_state through ``state_shardings``;
+    anything else (plain dicts) gets ``param_shardings`` wholesale."""
+    if isinstance(abstract, Mapping) and "params" in abstract:
+        out = dict(abstract)
+        shard_trees = {}
+        if "params" in out:
+            shard_trees["params"] = plan.param_shardings(out["params"])
+        if "batch_stats" in out:
+            shard_trees["batch_stats"] = plan.param_shardings(out["batch_stats"])
+        if "opt_state" in out:
+            shard_trees["opt_state"] = plan.state_shardings(
+                out["opt_state"], out["params"], with_offload=False
+            )
+        for key, shardings in shard_trees.items():
+            out[key] = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+                if hasattr(a, "shape") else a,
+                out[key], shardings,
+            )
+        for key in ("step", "rng"):
+            leaf = out.get(key)
+            if hasattr(leaf, "shape"):
+                out[key] = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=plan.replicated()
+                    ),
+                    leaf,
+                )
+        return out
+    shardings = plan.param_shardings(abstract)
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+        if hasattr(a, "shape") else a,
+        abstract, shardings,
+    )
+
+
 def _rebuffer(tree: Any) -> Any:
     """Deep-copy restored arrays into fresh XLA-owned buffers.
 
@@ -192,16 +354,22 @@ class Checkpointer:
         meta: Mapping[str, Any] | None = None,
         step: int | None = None,
         force: bool = False,
+        plan: Any = None,
     ) -> str:
         """Save state (+ metrics/meta JSON) at ``step`` (default: state.step).
 
         Every process must call this (sharded leaves are written
-        cooperatively); returns the checkpoint directory path.
+        cooperatively); returns the checkpoint directory path.  The step's
+        meta JSON carries a topology manifest derived from the live
+        leaves' shardings (``plan=`` additionally stamps the
+        ``ParallelPlan`` signature), which is what makes the step
+        restorable onto a different mesh shape (:meth:`restore`).
         """
         if step is None:
             step = int(jax.device_get(_state_data(state).get("step", 0) or 0))
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         meta = dict(meta or {})
+        manifest = topology_manifest(state, plan)
         # span + watchdog lease: a checkpoint write wedging on a dead
         # filesystem or a stuck collective is one of the documented silent
         # hangs — under a watchdog it becomes an attributed stall report
@@ -213,7 +381,9 @@ class Checkpointer:
                 step,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardSave(_state_data(state)),
-                    meta=ocp.args.JsonSave({"meta": meta, "metrics": metrics}),
+                    meta=ocp.args.JsonSave(
+                        {"meta": meta, "metrics": metrics, "topology": manifest}
+                    ),
                 ),
                 metrics=metrics or None,
                 force=force,
@@ -226,12 +396,27 @@ class Checkpointer:
         return path
 
     # -- restore -----------------------------------------------------------
-    def restore(self, state: Any, step: int | None = None) -> tuple[Any, dict]:
+    def restore(
+        self, state: Any, step: int | None = None, *, plan: Any = None
+    ) -> tuple[Any, dict]:
         """Restore ``step`` (default latest) into the template ``state``.
 
         The template supplies structure, dtypes and shardings — restored
         arrays land directly on device with the template's placement.
+        ``plan=`` overrides the template's shardings with the target
+        ``ParallelPlan``'s ``param_spec``/``state_spec`` assignments.
         Returns (new_state, meta_dict).
+
+        **Reshard-on-restore:** when the step's topology manifest differs
+        from the target topology (different mesh axis sizes / world
+        size — a shrink-to-survivors restart, or a deliberate scale-up),
+        the restore reshards at load: each leaf is gathered-or-sliced
+        from the saved partition layout into the target sharding (ZeRO/
+        FSDP optimizer shards re-partitioned, replicated leaves
+        broadcast), values bit-exact.  The boundary is loud — one
+        ``fault/reshard`` event with the old/new topology — and a
+        *logical* mismatch (global shapes differ: a different model, not
+        a different mesh) raises before any data is read.
         """
         if step is None:
             # newest *committed* step: orbax's own latest_step() counts
@@ -241,8 +426,36 @@ class Checkpointer:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         template = _state_data(state)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        if plan is not None:
+            abstract = _apply_plan_shardings(abstract, plan)
         tele = get_telemetry()
-        with tele.span("ckpt/restore", step=int(step)), tele.guard("ckpt/restore"):
+        manifest = read_manifest(self.directory, step)
+        target = _target_topology(abstract)
+        resharding = bool(
+            manifest
+            and target
+            and (
+                manifest.get("mesh_axes") != target["mesh_axes"]
+                or manifest.get("world_size") != target["world_size"]
+            )
+        )
+        if resharding:
+            _validate_manifest_compat(manifest, abstract)
+            tele.registry.counter("fault/reshards").inc()
+            tele.event(
+                "fault/reshard",
+                step=int(step),
+                from_axes=manifest.get("mesh_axes"),
+                to_axes=target["mesh_axes"],
+                from_world=manifest.get("world_size"),
+                to_world=target["world_size"],
+                from_plan=manifest.get("plan_signature"),
+                to_plan=plan.signature() if plan is not None else None,
+                leaves=len(manifest.get("leaves") or {}),
+            )
+        with tele.span(
+            "ckpt/restore", step=int(step), reshard=resharding
+        ), tele.guard("ckpt/restore"):
             restored = self._mgr.restore(
                 step,
                 args=ocp.args.Composite(
@@ -256,7 +469,9 @@ class Checkpointer:
             return dict(data), dict(extra.get("meta", {}))
         return state.replace(**data), dict(extra.get("meta", {}))
 
-    def maybe_restore(self, state: Any, step: int | None = None) -> tuple[Any, dict | None]:
+    def maybe_restore(
+        self, state: Any, step: int | None = None, *, plan: Any = None
+    ) -> tuple[Any, dict | None]:
         """Restore if any *valid* checkpoint exists, else pass through
         (auto-resume).  A directory holding only torn saves passes
         through too — a fresh start beats a crash loop on corrupt state
@@ -264,7 +479,7 @@ class Checkpointer:
         the torn dirs so they stop shadowing real steps)."""
         if self.latest_step() is None:
             return state, None
-        new_state, meta = self.restore(state, step)
+        new_state, meta = self.restore(state, step, plan=plan)
         return new_state, meta
 
     # -- queries -----------------------------------------------------------
@@ -292,6 +507,11 @@ class Checkpointer:
             self._mgr.delete(step)
         except (FileNotFoundError, KeyError):
             pass  # already gone / never existed
+
+    def manifest_for(self, step: int | None = None) -> dict | None:
+        """The topology manifest bundled with ``step`` (default latest
+        committed); None for pre-manifest or manifest-free checkpoints."""
+        return read_manifest(self.directory, step)
 
     def metrics_for(self, step: int) -> dict:
         """The metrics JSON bundled with ``step`` (Ray-style result reload)."""

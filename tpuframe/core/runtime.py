@@ -122,6 +122,41 @@ class MeshSpec:
             raise ValueError(f"unknown mesh axes {sorted(unknown)}; known: {sorted(known)}")
         return cls(**{k: int(v) for k, v in cfg.items()})
 
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "MeshSpec":
+        """The concrete spec of an already-built mesh (every axis fixed,
+        no wildcard) — the starting point for re-deriving a spec over a
+        different world size (:meth:`shrink_to`)."""
+        return cls.from_config(
+            {name: int(size) for name, size in mesh.shape.items()}
+        )
+
+    def shrink_to(self, n_devices: int, *, elastic_axis: str = DATA_AXIS) -> "MeshSpec":
+        """The equivalent spec for a smaller/larger world: ``elastic_axis``
+        (default ``data``) absorbs the size change, every other axis keeps
+        its layout.  Raises when the fixed axes no longer fit — losing a
+        host out of a TP/PP group cannot be absorbed by data parallelism,
+        and silently reshaping model parallelism would change the program.
+        """
+        sizes = dict(self.sizes())
+        wildcard = [n for n, s in sizes.items() if s == -1 and n != elastic_axis]
+        if wildcard:
+            raise ValueError(
+                f"shrink_to needs a fully-resolved spec (use "
+                f"MeshSpec.from_mesh on the built mesh); axis {wildcard} "
+                "is still a wildcard"
+            )
+        sizes[elastic_axis] = -1
+        fixed = int(np.prod([s for n, s in sizes.items() if n != elastic_axis]))
+        if n_devices < 1 or n_devices % fixed:
+            raise ValueError(
+                f"cannot rebuild mesh for {n_devices} device(s): the fixed "
+                f"axes {({n: s for n, s in sizes.items() if n != elastic_axis and s > 1})} "
+                f"need a multiple of {fixed} — shrink in units of whole "
+                f"{elastic_axis}-groups or lower min_world_size no further"
+            )
+        return MeshSpec.from_config(sizes)
+
 
 @dataclasses.dataclass
 class Runtime:

@@ -142,7 +142,63 @@ def compile_section() -> dict:
     return info
 
 
-def report(probe_timeout_s: float = 30.0) -> dict:
+def ckpt_section(directory: str | None = None,
+                 device_count: int | None = None) -> dict | None:
+    """State of a checkpoint directory (``--ckpt-dir`` /
+    ``TPUFRAME_CKPT_DIR``): committed steps, quarantined torn steps, and
+    the latest committed step's **topology manifest** — the mesh shape
+    the checkpoint was saved under.  When the manifest's world size
+    disagrees with the probed backend, the section carries a warning
+    with the reshard-restore one-liner: the checkpoint is still usable,
+    it just restores onto a rebound plan (FAULT.md "Elastic recovery").
+    Stdlib-only reads — works against a wedged backend."""
+    directory = directory or os.environ.get("TPUFRAME_CKPT_DIR")
+    if not directory:
+        return None
+    from tpuframe.ckpt.checkpoint import read_manifest, valid_steps
+
+    steps = valid_steps(directory)
+    qdir = os.path.join(directory, "_quarantine")
+    try:
+        quarantined = sorted(os.listdir(qdir))
+    except (FileNotFoundError, NotADirectoryError):
+        quarantined = []
+    out: dict = {
+        "directory": os.path.abspath(directory),
+        "committed_steps": steps[-5:],
+        "latest_step": steps[-1] if steps else None,
+        "quarantined": quarantined,
+    }
+    manifest = read_manifest(directory, steps[-1]) if steps else None
+    if manifest is not None:
+        out["topology"] = {
+            "mesh_axes": manifest.get("mesh_axes"),
+            "world_size": manifest.get("world_size"),
+            "process_count": manifest.get("process_count"),
+            "plan_signature": manifest.get("plan_signature"),
+            "zero_stage": manifest.get("zero_stage"),
+            "leaves": len(manifest.get("leaves") or {}),
+        }
+        saved_world = manifest.get("world_size")
+        if (
+            isinstance(device_count, int)
+            and isinstance(saved_world, int)
+            and device_count != saved_world
+        ):
+            out["warning"] = (
+                f"checkpoint topology (world={saved_world}, mesh="
+                f"{manifest.get('mesh_axes')}) != current backend "
+                f"({device_count} device(s)): restore reshards at load — "
+                "build the survivor mesh, plan = old_plan.rebind(mesh), "
+                "then Checkpointer.restore(template, plan=plan) (or "
+                "launch.run_elastic, which does all three)"
+            )
+    elif steps:
+        out["topology"] = None  # pre-manifest checkpoint (or host-numpy state)
+    return out
+
+
+def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None) -> dict:
     """Collect the full environment report (pure data; printing is main's)."""
     import tpuframe
 
@@ -183,6 +239,7 @@ def report(probe_timeout_s: float = 30.0) -> dict:
         # compile_cache_dir key: the spine enables the cache via
         # jax.config, so the env var being unset says nothing
         "compile": compile_section(),
+        "ckpt": ckpt_section(ckpt_dir, devices.get("device_count")),
         "env": {
             k: os.environ[k]
             for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
@@ -201,8 +258,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--probe-timeout", type=float, default=30.0,
                     help="seconds before declaring the backend wedged")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint directory to report on (committed "
+                         "steps + the latest step's topology manifest; "
+                         "default: TPUFRAME_CKPT_DIR)")
     args = ap.parse_args(argv)
-    rec = report(args.probe_timeout)
+    rec = report(args.probe_timeout, args.ckpt_dir)
     print(json.dumps(rec, indent=2))
     return 1 if "error" in rec["devices"] else 0
 

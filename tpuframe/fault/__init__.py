@@ -23,10 +23,14 @@ from tpuframe.fault.chaos import (
     ChaosPlan,
     Injector,
     KillWorker,
+    LoseRank,
     PreemptNotice,
     RaiseAt,
+    RankLostError,
     StallAt,
     TornCheckpoint,
+    lost_ranks,
+    reset_lost_ranks,
 )
 from tpuframe.fault.preempt import (
     PREEMPTED_EXIT,
@@ -39,6 +43,7 @@ from tpuframe.fault.supervisor import (
     FailureClass,
     RestartPolicy,
     Supervisor,
+    WorldTooSmall,
     backoff_delay,
     classify_failure,
     run_supervised,
@@ -50,18 +55,23 @@ __all__ = [
     "FailureClass",
     "Injector",
     "KillWorker",
+    "LoseRank",
     "PREEMPTED_EXIT",
     "Preempted",
     "PreemptNotice",
     "PreemptionWatcher",
     "RaiseAt",
+    "RankLostError",
     "RestartPolicy",
     "StallAt",
     "Supervisor",
     "TornCheckpoint",
+    "WorldTooSmall",
     "backoff_delay",
     "classify_failure",
     "gce_maintenance_poller",
+    "lost_ranks",
     "preemption_requested",
+    "reset_lost_ranks",
     "run_supervised",
 ]

@@ -48,12 +48,16 @@ __all__ = [
     "ChaosPlan",
     "Injector",
     "KillWorker",
+    "LoseRank",
     "PreemptNotice",
     "RaiseAt",
+    "RankLostError",
     "StallAt",
     "TornCheckpoint",
     "active_plan",
+    "lost_ranks",
     "maybe_fire",
+    "reset_lost_ranks",
     "site",
 ]
 
@@ -62,6 +66,12 @@ class ChaosError(OSError):
     """Default injected failure type — an OSError subclass, so the stock
     failure classifier treats it as retryable infra (the point of most
     chaos runs is to drive the *recovery* path, not the fatal path)."""
+
+
+class RankLostError(ChaosError):
+    """A peer rank died under the fleet — what the survivors' next
+    collective surfaces (on real pods: a RuntimeError out of the wedged
+    transport).  Retryable infra, like its parent."""
 
 
 class Injector:
@@ -183,6 +193,41 @@ class KillWorker(Injector):
         os.kill(os.getpid(), self.sig)
 
 
+class LoseRank(Injector):
+    """Lose rank(s) from the fleet at a step — the shrink-scenario
+    injector.  Fires from the *survivors'* point of view: the lost
+    rank(s) are registered in the process-wide lost set (capacity probes
+    — ``launch.elastic`` — consult it to report the shrunken world) and
+    a :class:`RankLostError` is raised at the site, exactly where a real
+    dead peer surfaces as a failed step collective.  The loss persists
+    across supervised in-process restarts and is cleared when the plan
+    deactivates, so a chaos run's world damage is scoped to its plan.
+
+    ``rank`` may be an int or an iterable of ints (one host dying takes
+    all of its chips/ranks at once).  Same seeded determinism as every
+    other injector: ``ChaosPlan.scheduled(seed, sites={"step":
+    LoseRank(3)})`` draws the loss step from the seed.
+    """
+
+    def __init__(self, rank: int | Sequence[int], at_step: int | None = None, *,
+                 site: str = "step", times: int = 1):
+        super().__init__(site, at_step, times=times)
+        self.ranks = tuple(rank) if isinstance(rank, (tuple, list, set, frozenset)) \
+            else (int(rank),)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        with _LOST_LOCK:
+            _LOST_RANKS.update(int(r) for r in self.ranks)
+        raise RankLostError(
+            f"chaos: rank(s) {sorted(self.ranks)} lost at "
+            f"{self.site} step {ctx.get('step')}"
+        )
+
+    def describe(self) -> str:
+        return (f"LoseRank(ranks={sorted(self.ranks)}, site={self.site!r}, "
+                f"step={self.step})")
+
+
 class PreemptNotice(Injector):
     """Trip the process-wide preemption watcher at the site — a
     deterministic SIGTERM stand-in.  The Trainer then runs its real
@@ -288,6 +333,30 @@ class ChaosPlan:
         finally:
             with _ACTIVE_LOCK:
                 _ACTIVE = None
+            # world damage is plan-scoped: a LoseRank's lost set persists
+            # across supervised restarts *inside* the activation (the
+            # capacity probe must keep seeing the shrunken world) and
+            # resets here so one test's dead ranks never leak into the next
+            reset_lost_ranks()
+
+
+# -- lost-rank registry (LoseRank's world damage) -----------------------------
+
+_LOST_RANKS: set[int] = set()
+_LOST_LOCK = threading.Lock()
+
+
+def lost_ranks() -> frozenset[int]:
+    """Ranks removed from the fleet by :class:`LoseRank` injectors —
+    what a simulated capacity probe subtracts from the original world."""
+    with _LOST_LOCK:
+        return frozenset(_LOST_RANKS)
+
+
+def reset_lost_ranks() -> None:
+    """Clear the lost set (plan deactivation does this automatically)."""
+    with _LOST_LOCK:
+        _LOST_RANKS.clear()
 
 
 # -- call-site hooks ----------------------------------------------------------

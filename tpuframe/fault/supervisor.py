@@ -24,6 +24,15 @@ Every decision is observable: ``fault/restart`` events carry the
 failure class, attempt number and delay; ``fault/restarts`` /
 ``fault/preemptions`` counters accumulate; ``fault/giveup`` records why
 a run was allowed to die.
+
+With a ``capacity_probe`` the supervisor is additionally **elastic**:
+surviving capacity is probed before every attempt, a shrink/grow emits
+``fault/world_resized``, the attempt fn receives the new world size
+(``launch.elastic.run_elastic`` turns that into a rebuilt mesh + rebound
+plan + reshard-restore), and the run gives up only when survivors fall
+below ``min_world_size`` — TorchTitan's "recoverable AND reconfigurable"
+production requirement, instead of retrying into a world that no longer
+exists until the budget dies.
 """
 
 from __future__ import annotations
@@ -44,10 +53,17 @@ __all__ = [
     "FailureClass",
     "RestartPolicy",
     "Supervisor",
+    "WorldTooSmall",
     "backoff_delay",
     "classify_failure",
     "run_supervised",
 ]
+
+
+class WorldTooSmall(RuntimeError):
+    """Surviving capacity fell below the supervisor's ``min_world_size``
+    floor — the elastic giveup, distinct from budget exhaustion (the job
+    *could* keep restarting; it is not worth running this small)."""
 
 
 class FailureClass(enum.Enum):
@@ -150,6 +166,19 @@ class Supervisor:
       on_restart: ``(attempt, error)`` observability hook, called before
         the backoff sleep (log, page, mark the run).
       sleep: injectable for tests.
+      capacity_probe: optional ``() -> int`` returning the currently
+        *available* world size (devices/ranks), probed before **every**
+        attempt.  With a probe, ``fn`` is called as ``fn(world_size)`` so
+        the attempt can rebuild its runtime for the surviving capacity
+        (``launch.elastic.run_elastic`` wires mesh-rebuild + plan-rebind
+        + reshard-restore on top of this); a shrink/grow between
+        attempts emits one ``fault/world_resized`` event.  Without a
+        probe the supervisor keeps today's equal-capacity contract and
+        calls ``fn()``.
+      min_world_size: elastic floor — when the probe reports fewer
+        survivors, give up (``fault/giveup`` reason ``min-world-size``,
+        :class:`WorldTooSmall`) instead of limping below the smallest
+        world the job is worth running on.
     """
 
     def __init__(
@@ -160,6 +189,8 @@ class Supervisor:
         classifier: Callable[[BaseException], FailureClass] | None = None,
         on_restart: Callable[[int, BaseException], None] | None = None,
         sleep: Callable[[float], None] = time.sleep,
+        capacity_probe: Callable[[], int] | None = None,
+        min_world_size: int = 1,
     ):
         self.policy = policy or RestartPolicy()
         self.checkpoint_dir = checkpoint_dir
@@ -168,6 +199,13 @@ class Supervisor:
         self.sleep = sleep
         self.retries = 0
         self.preemptions = 0
+        if min_world_size < 1:
+            raise ValueError(f"min_world_size must be >= 1, got {min_world_size}")
+        self.capacity_probe = capacity_probe
+        self.min_world_size = min_world_size
+        #: current probed world size (None until the first probe; stays
+        #: None for non-elastic supervisors with no probe)
+        self.world_size: int | None = None
 
     # -- pre-resume validation ----------------------------------------------
     def validate_checkpoints(self) -> list[str]:
@@ -205,8 +243,45 @@ class Supervisor:
         except Exception:
             return None  # a broken cache must not block recovery
 
+    # -- elastic capacity ----------------------------------------------------
+    def _probe_world(self) -> None:
+        """Probe surviving capacity before an attempt: record resizes as
+        one loud ``fault/world_resized`` event each, and give up
+        (:class:`WorldTooSmall`) when survivors fall below the floor —
+        raised *outside* the retry try-block, so it is never itself
+        retried."""
+        if self.capacity_probe is None:
+            return
+        n = int(self.capacity_probe())
+        tele = get_telemetry()
+        old = self.world_size
+        if old is not None and n != old:
+            tele.registry.counter("fault/world_resizes").inc()
+            tele.event(
+                "fault/world_resized",
+                from_world=old,
+                to_world=n,
+                min_world_size=self.min_world_size,
+                attempt=self.retries + self.preemptions,
+            )
+            logger.warning(
+                "world resized %d -> %d survivor(s); restarting at the "
+                "smaller world (floor: %d)", old, n, self.min_world_size,
+            )
+        self.world_size = n
+        if n < self.min_world_size:
+            tele.event(
+                "fault/giveup", reason="min-world-size",
+                world_size=n, min_world_size=self.min_world_size,
+            )
+            raise WorldTooSmall(
+                f"surviving capacity {n} fell below min_world_size="
+                f"{self.min_world_size}; giving up rather than training "
+                "on a world too small to be worth the schedule"
+            )
+
     # -- the loop ------------------------------------------------------------
-    def run(self, fn: Callable[[], Any]) -> Any:
+    def run(self, fn: Callable[..., Any]) -> Any:
         tele = get_telemetry()
         compile_cache_dir = self._ensure_compile_cache()
         while True:
@@ -216,8 +291,9 @@ class Supervisor:
                     "quarantined %d torn checkpoint step(s): %s",
                     len(quarantined), quarantined,
                 )
+            self._probe_world()
             try:
-                return fn()
+                return fn(self.world_size) if self.capacity_probe else fn()
             except BaseException as e:
                 cls = self.classifier(e)
                 if cls is FailureClass.FATAL:

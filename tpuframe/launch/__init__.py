@@ -31,7 +31,13 @@ from tpuframe.launch.distributor import (
     WorkerLostError,
     ZeroDistributor,
 )
-from tpuframe.launch.elastic import run_with_restarts
+from tpuframe.launch.elastic import (
+    ElasticContext,
+    rederive_batch_split,
+    run_elastic,
+    run_with_restarts,
+    simulated_survivor_probe,
+)
 from tpuframe.launch.remote import (
     RemoteDistributor,
     RemoteLaunchError,
@@ -56,7 +62,11 @@ __all__ = [
     "ssh_connect",
     "WorkerLostError",
     "ZeroDistributor",
+    "ElasticContext",
+    "rederive_batch_split",
+    "run_elastic",
     "run_with_restarts",
+    "simulated_survivor_probe",
     "Checkpoint",
     "Result",
     "RunConfig",

@@ -1,22 +1,33 @@
-"""Elastic recovery: checkpoint-resume restart loop.
+"""Elastic recovery: checkpoint-resume restart loops, now
+**topology-shifting**.
 
-Thin compatibility front for :mod:`tpuframe.fault.supervisor` — the
-original 58-line constant-backoff loop grew into a real subsystem
-(failure-classified budgets, exponential backoff with full jitter,
-pre-resume quarantine of torn checkpoints) and lives there now.  This
-entry point keeps the established signature: ``backoff_s`` is the *base*
-delay of the jittered exponential schedule, and ``retryable`` still
-overrides failure classification.
+Two layers:
+
+- :func:`run_with_restarts` — the established equal-capacity entry
+  point, a thin front for :mod:`tpuframe.fault.supervisor`
+  (failure-classified budgets, jittered exponential backoff, pre-resume
+  quarantine of torn checkpoints).
+- :func:`run_elastic` — shrink-to-survivors supervision: before every
+  attempt the supervisor probes surviving capacity; when the world
+  shrank, this layer rebuilds the runtime mesh from the survivors,
+  rebinds the ``ParallelPlan`` (``ParallelPlan.rebind``), and hands the
+  train fn an :class:`ElasticContext` whose plan restores checkpoints
+  **with reshard** (the topology manifest every committed step carries —
+  ``tpuframe.ckpt``).  The run gives up only when survivors fall below
+  ``min_world_size``.  :func:`rederive_batch_split` keeps the *global*
+  batch constant across the resize so the data-order contract (the
+  consumer-true loader position inside checkpoints) survives the shrink.
 
 tpuframe's recovery model is unchanged: training state lives in a
 :class:`tpuframe.ckpt.Checkpointer` with auto-resume (``maybe_restore``),
 so recovery = rerun the train fn and let it pick up the newest committed
-checkpoint.
+checkpoint — at whatever world size is still alive.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import dataclasses
+from typing import Any, Callable, Sequence
 
 from tpuframe.fault.supervisor import (
     FATAL_TYPES as _FATAL,  # noqa: F401  (compat re-export)
@@ -25,6 +36,7 @@ from tpuframe.fault.supervisor import (
     Supervisor,
     classify_failure,
 )
+from tpuframe.track.telemetry import get_telemetry
 
 
 def run_with_restarts(
@@ -75,3 +87,208 @@ def run_with_restarts(
         classifier=classifier,
         on_restart=on_restart,
     ).run(fn)
+
+
+# -- topology-shifting supervision (shrink to survivors) ----------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticContext:
+    """What one supervised attempt needs to know about its world.
+
+    ``plan`` is the :class:`~tpuframe.parallel.ParallelPlan` to train
+    under **this attempt** — the original plan at full capacity, the
+    rebound plan over the survivor mesh after a shrink.  Build the
+    Trainer/TrainState from it and checkpoints restore-with-reshard
+    automatically (the template's shardings are the reshard target).
+    """
+
+    attempt: int
+    world_size: int
+    initial_world_size: int
+    plan: Any
+    #: True when this attempt runs on a different world than the last one
+    resized: bool
+
+    @property
+    def mesh(self):
+        return self.plan.mesh
+
+
+def simulated_survivor_probe(initial_world: int) -> Callable[[], int]:
+    """Capacity probe for CPU chaos runs: the original world minus the
+    ranks :class:`tpuframe.fault.LoseRank` injectors have killed (one
+    simulated rank == one device).  Production supplies a real probe —
+    k8s endpoints, TPU pod metadata, an orchestrator's member list."""
+    from tpuframe.fault import chaos
+
+    def probe() -> int:
+        lost = sum(1 for r in chaos.lost_ranks() if 0 <= r < initial_world)
+        return initial_world - lost
+
+    return probe
+
+
+def rederive_batch_split(
+    global_batch: int,
+    *,
+    dp_size: int,
+    grad_accum: int = 1,
+    process_count: int = 1,
+) -> dict:
+    """Re-derive the per-process batch / grad-accum split for a new
+    ``dp_size`` while holding the **global** batch fixed.
+
+    The global batch is the data-order contract: checkpoints record the
+    loader position in units of global batches, and the LR schedule is
+    calibrated to it — so a world resize must change the *split*, never
+    the product.  Keeps ``grad_accum`` when the microbatch still divides
+    over the new shards; otherwise picks the nearest divisor of
+    ``global_batch`` that does (one ``fault/batch_resplit`` event marks
+    the change).  Raises when no split exists (``global_batch`` not a
+    multiple of ``dp_size``).
+    """
+    if global_batch < 1 or dp_size < 1 or grad_accum < 1 or process_count < 1:
+        raise ValueError("all batch-split inputs must be >= 1")
+    if global_batch % process_count:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{process_count} surviving process(es)"
+        )
+    candidates = sorted(
+        (a for a in range(1, global_batch + 1) if global_batch % a == 0),
+        key=lambda a: (abs(a - grad_accum), a),
+    )
+    for ga in candidates:
+        if (global_batch // ga) % dp_size == 0:
+            if ga != grad_accum:
+                get_telemetry().event(
+                    "fault/batch_resplit",
+                    global_batch=global_batch,
+                    dp_size=dp_size,
+                    from_grad_accum=grad_accum,
+                    to_grad_accum=ga,
+                )
+            return {
+                "global_batch": global_batch,
+                "local_batch": global_batch // process_count,
+                "grad_accum": ga,
+                "micro_batch": global_batch // ga // dp_size,
+            }
+    raise ValueError(
+        f"no grad-accum split preserves global batch {global_batch} over "
+        f"{dp_size} data-parallel shards — the global batch must be a "
+        "multiple of the surviving dp size (shrink further or change "
+        "the schedule deliberately)"
+    )
+
+
+def _survivor_context(
+    base_plan: Any,
+    base_devices: Sequence[Any],
+    world: int,
+    attempt: int,
+    *,
+    elastic_axis: str,
+) -> ElasticContext:
+    """Rebuild mesh + plan for ``world`` survivors of ``base_devices``.
+
+    Survivor selection: the base mesh's device order minus chaos-lost
+    ranks, truncated to ``world`` — a real multi-host deployment replaces
+    this whole function via ``train_fn`` constructing its own runtime,
+    but the contract (same axis layout, ``elastic_axis`` absorbs the
+    change) is the one ``MeshSpec.shrink_to`` enforces either way."""
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.fault import chaos
+
+    world0 = len(base_devices)
+    if world == world0:
+        return ElasticContext(
+            attempt=attempt, world_size=world, initial_world_size=world0,
+            plan=base_plan, resized=False,
+        )
+    if world > world0:
+        # the reshard-restore itself grows as readily as it shrinks, but
+        # survivor selection is bounded by the base mesh's device list —
+        # reporting a bigger world than the plan knows would silently
+        # build a smaller mesh than fault/world_resized announced
+        raise ValueError(
+            f"capacity probe reports {world} device(s) but the base plan "
+            f"only spans {world0}: growing beyond the original mesh needs "
+            "a new base ParallelPlan over the larger device set (restart "
+            "run_elastic with it; the checkpoint manifest reshards up at "
+            "restore just the same)"
+        )
+    lost = chaos.lost_ranks()
+    survivors = [d for i, d in enumerate(base_devices) if i not in lost]
+    if len(survivors) < world:  # custom probe, no chaos registry
+        survivors = list(base_devices)
+    survivors = survivors[:world]
+    spec = MeshSpec.from_mesh(base_plan.mesh).shrink_to(
+        world, elastic_axis=elastic_axis
+    )
+    mesh = spec.build(survivors)
+    return ElasticContext(
+        attempt=attempt, world_size=world, initial_world_size=world0,
+        plan=base_plan.rebind(mesh), resized=True,
+    )
+
+
+def run_elastic(
+    train_fn: Callable[[ElasticContext], Any],
+    *,
+    plan: Any,
+    policy: RestartPolicy | None = None,
+    checkpoint_dir: str | None = None,
+    capacity_probe: Callable[[], int] | None = None,
+    min_world_size: int = 1,
+    elastic_axis: str | None = None,
+    **kwargs: Any,
+) -> Any:
+    """Supervise ``train_fn`` with **shrink-to-survivors** recovery.
+
+    Each attempt: the supervisor probes surviving capacity
+    (``capacity_probe``; default: the chaos lost-rank registry under the
+    plan's original world — CPU simulation), rebuilds the mesh from the
+    survivors when the world changed (``elastic_axis`` — default the
+    ``data`` axis — absorbs the size change; TP/PP axes keep their
+    layout or the rebuild refuses), rebinds ``plan``, and calls
+    ``train_fn(ctx)``.  The fn builds its Trainer/TrainState from
+    ``ctx.plan``; auto-resume then restores the last committed
+    checkpoint **with reshard** (manifest-vs-target mismatch =>
+    gather-or-slice at load, one ``fault/reshard`` event).  Below
+    ``min_world_size`` survivors the supervisor gives up
+    (:class:`~tpuframe.fault.WorldTooSmall`).
+
+    All other knobs (``policy``, ``checkpoint_dir`` pre-resume
+    quarantine, ``classifier``, ``on_restart``, ``sleep``) pass through
+    to :class:`~tpuframe.fault.Supervisor`.
+    """
+    from tpuframe.core.runtime import DATA_AXIS
+
+    base_devices = list(plan.mesh.devices.flat)
+    if capacity_probe is None:
+        capacity_probe = simulated_survivor_probe(len(base_devices))
+    axis = elastic_axis or DATA_AXIS
+    attempts = {"n": 0}
+
+    def attempt(world: int) -> Any:
+        attempts["n"] += 1
+        # a (re)started attempt runs on a (re)built world: re-arm the
+        # fleet-gather ladder a dead peer may have degraded to sticky
+        # local-only — the peer that wedged it is no longer in this mesh
+        from tpuframe.track.analyze import reset_fleet_degraded
+
+        reset_fleet_degraded()
+        ctx = _survivor_context(
+            plan, base_devices, int(world), attempts["n"], elastic_axis=axis
+        )
+        return train_fn(ctx)
+
+    return Supervisor(
+        policy,
+        checkpoint_dir=checkpoint_dir,
+        capacity_probe=capacity_probe,
+        min_world_size=min_world_size,
+        **kwargs,
+    ).run(attempt)

@@ -18,7 +18,10 @@ from tpuframe.parallel.sharding import (
     ParallelPlan,
     Rule,
     infer_shard_dim,
+    mesh_axes,
     path_str,
+    spec_from_json,
+    spec_to_json,
 )
 from tpuframe.parallel.pipeline import (
     PipelinedTransformerLM,
@@ -53,7 +56,10 @@ __all__ = [
     "ParallelPlan",
     "Rule",
     "infer_shard_dim",
+    "mesh_axes",
     "path_str",
+    "spec_from_json",
+    "spec_to_json",
     "ZeroConfig",
     "host_offload_sharding",
     "supports_host_offload",

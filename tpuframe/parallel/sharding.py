@@ -25,6 +25,8 @@ collectives with compute on its own.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import re
 import warnings
 from typing import Any, Sequence
@@ -37,6 +39,28 @@ from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS
 
 #: A tensor-parallel rule: (regex over the param path, PartitionSpec).
 Rule = tuple[str, P]
+
+
+def spec_to_json(spec: P) -> list:
+    """A PartitionSpec as plain JSON: each entry None, a str, or a list
+    of strs — the form checkpoint topology manifests store per leaf."""
+    out: list = []
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:  # tuple of axis names
+            out.append(list(entry))
+    return out
+
+
+def spec_from_json(entries: Sequence) -> P:
+    """Inverse of :func:`spec_to_json`."""
+    return P(*(tuple(e) if isinstance(e, list) else e for e in entries))
+
+
+def mesh_axes(mesh: Mesh) -> dict[str, int]:
+    """``{axis_name: size}`` for a mesh — the manifest's topology key."""
+    return {str(name): int(size) for name, size in mesh.shape.items()}
 
 
 def host_memory_available(mesh: Mesh | None = None) -> bool:
@@ -141,6 +165,76 @@ class ParallelPlan:
 
     def _offload_active(self) -> bool:
         return self.offload_optimizer and host_memory_available(self.mesh)
+
+    # -- identity / topology ----------------------------------------------
+    def signature(self) -> str:
+        """Stable short digest of the plan's *policy + topology*: mesh
+        axis names/sizes, ZeRO stage, TP rules, thresholds.  Two plans
+        with equal signatures lower the same step program for the same
+        batch signature, so this is the key the compile spine (and the
+        checkpoint topology manifest) uses to tell "same plan, rebound"
+        from "different plan".  Deliberately excludes device identities:
+        the same logical shape on different physical chips is the same
+        program."""
+        payload = {
+            "mesh": sorted(mesh_axes(self.mesh).items()),
+            "zero_stage": self.zero_stage,
+            "rules": [[pat, spec_to_json(spec)] for pat, spec in self.rules],
+            "min_shard_elems": self.min_shard_elems,
+            "fsdp_axis": self.fsdp_axis,
+            "data_axes": list(self.data_axes),
+            "offload": bool(self.offload_optimizer),
+        }
+        blob = json.dumps(payload, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:12]
+
+    def describe_topology(self) -> dict:
+        """The plan's topology as manifest-shaped JSON (mesh axes, world
+        size, signature) — what ``fault/world_resized`` events carry."""
+        return {
+            "mesh_axes": mesh_axes(self.mesh),
+            "world_size": int(self.mesh.devices.size),
+            "plan_signature": self.signature(),
+            "zero_stage": self.zero_stage,
+        }
+
+    def rebind(self, mesh: Mesh) -> "ParallelPlan":
+        """Re-derive an equivalent plan over a different mesh (the elastic
+        shrink/grow path): every policy knob — ZeRO stage, TP rules,
+        thresholds — carries over; only the topology changes.  Axis
+        *collapses* (an axis that was >1 now 1: ZeRO sharding vanishing
+        when ``fsdp`` collapses, TP rules going inert when ``model``
+        does) are loud — one ``parallel/plan_rebind`` event with the
+        old/new axes plus a warning, because the memory/layout contract
+        the old plan bought silently disappears otherwise."""
+        from tpuframe.track.telemetry import get_telemetry
+
+        old_axes, new_axes = mesh_axes(self.mesh), mesh_axes(mesh)
+        new = dataclasses.replace(self, mesh=mesh)
+        collapsed = sorted(
+            a for a in old_axes
+            if old_axes.get(a, 1) > 1 and new_axes.get(a, 1) == 1
+        )
+        get_telemetry().event(
+            "parallel/plan_rebind",
+            from_axes=old_axes,
+            to_axes=new_axes,
+            from_world=int(self.mesh.devices.size),
+            to_world=int(mesh.devices.size),
+            collapsed=collapsed,
+            signature=new.signature(),
+        )
+        if collapsed:
+            warnings.warn(
+                f"plan rebind collapsed mesh axis(es) {collapsed} to size 1 "
+                f"({old_axes} -> {new_axes}): sharding over those axes is "
+                "now inert (ZeRO partitions gather to every replica when "
+                "fsdp collapses; TP rules naming a collapsed axis "
+                "replicate).  Expected when shrinking to survivors — but "
+                "re-check the memory budget fits the new world.",
+                stacklevel=2,
+            )
+        return new
 
     # -- axis helpers ------------------------------------------------------
     def axis_size(self, name: str) -> int:

@@ -64,10 +64,12 @@ __all__ = [
     "baseline_diff",
     "build_trace",
     "fleet_allgather",
+    "fleet_degraded",
     "format_report",
     "load_dir",
     "load_rank",
     "main",
+    "reset_fleet_degraded",
     "skew_report",
 ]
 
@@ -729,21 +731,34 @@ def format_report(report: dict, diff: dict | None = None, *,
 # -- live straggler detection -------------------------------------------------
 
 
-def fleet_allgather(value: float) -> list[float]:
-    """All ranks' values, rank-ordered — THE tiny fleet collective, with
-    one degradation ladder shared by straggler detection and
-    :func:`tpuframe.fault.preempt.agree` (which delegates here): a
-    process that never imported jax is by definition not part of a
-    multi-host jax runtime (local-only, without importing jax or
-    initializing its backend); with jax live, single-process
-    short-circuits; and the multi-process-CPU test topology degrades to
-    local rather than crash the loop it is watching (XLA's CPU backend
-    cannot run multiprocess computations — real pods are TPU/GPU)."""
-    jax = sys.modules.get("jax")
-    if jax is None:
-        return [float(value)]
-    if jax.process_count() == 1 or jax.default_backend() == "cpu":
-        return [float(value)]
+#: Wall bound (seconds) on the fleet gather when a peer is dead — a lost
+#: rank must degrade the ladder, not hang every healthy survivor at the
+#: step boundary forever.  ``TPUFRAME_FLEET_TIMEOUT_S``; 0 disables.
+FLEET_TIMEOUT_ENV = "TPUFRAME_FLEET_TIMEOUT_S"
+_FLEET_TIMEOUT_DEFAULT_S = 60.0
+
+#: Sticky local-only mode after a gather timed out: the wedged collective
+#: left a dangling thread inside the runtime, and re-entering it every
+#: boundary would leak one thread per step while the fleet is broken.
+_FLEET_DEGRADED = False
+
+
+def fleet_degraded() -> bool:
+    """True once a fleet gather timed out on a lost peer (local-only mode
+    until :func:`reset_fleet_degraded` — typically the supervised restart
+    into a rebuilt world)."""
+    return _FLEET_DEGRADED
+
+
+def reset_fleet_degraded() -> None:
+    """Re-arm fleet gathers (a restart into a rebuilt/shrunken world has
+    a live fleet again; tests)."""
+    global _FLEET_DEGRADED
+    _FLEET_DEGRADED = False
+
+
+def _gather_values(value: float) -> list[float]:
+    """The real cross-process gather (factored for bounding + tests)."""
     import numpy as np
     from jax.experimental import multihost_utils
 
@@ -751,6 +766,80 @@ def fleet_allgather(value: float) -> list[float]:
         np.asarray([value], dtype=np.float64)
     )
     return [float(v) for v in np.asarray(vals).ravel()]
+
+
+def _fleet_timeout_s() -> float:
+    raw = os.environ.get(FLEET_TIMEOUT_ENV, "").strip()
+    if not raw:
+        return _FLEET_TIMEOUT_DEFAULT_S
+    try:
+        return float(raw)
+    except ValueError:
+        return _FLEET_TIMEOUT_DEFAULT_S
+
+
+def _bounded_gather(value: float, timeout_s: float | None = None) -> list[float]:
+    """Run the gather with a wall bound: on timeout (or a transport
+    error — a dead peer surfaces as either), emit ONE ``fault/peer_lost``
+    event, flip the ladder to sticky local-only, and return the local
+    value so the step boundary completes instead of hanging.  The
+    timed-out gather thread is a daemon parked inside the runtime; it
+    dies with the process (which the supervisor is about to restart
+    anyway — a hung collective means the fleet is already broken)."""
+    global _FLEET_DEGRADED
+    timeout_s = _fleet_timeout_s() if timeout_s is None else float(timeout_s)
+    if timeout_s <= 0:
+        return _gather_values(value)
+    import threading
+
+    box: dict[str, Any] = {}
+
+    def work() -> None:
+        try:
+            box["result"] = _gather_values(value)
+        except BaseException as e:  # noqa: BLE001 - reported, not swallowed
+            box["error"] = e
+
+    t = threading.Thread(target=work, name="tpuframe-fleet-gather", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "result" in box:
+        return box["result"]
+    _FLEET_DEGRADED = True
+    tele = get_telemetry()
+    tele.registry.counter("fault/peer_losses").inc()
+    tele.event(
+        "fault/peer_lost",
+        timeout_s=timeout_s,
+        error=(repr(box["error"])[:300] if "error" in box
+               else f"gather exceeded {timeout_s}s wall bound"),
+        degraded_to="local",
+    )
+    return [float(value)]
+
+
+def fleet_allgather(value: float) -> list[float]:
+    """All ranks' values, rank-ordered — THE tiny fleet collective, with
+    one degradation ladder shared by straggler detection and
+    :func:`tpuframe.fault.preempt.agree` (which delegates here): a
+    process that never imported jax is by definition not part of a
+    multi-host jax runtime (local-only, without importing jax or
+    initializing its backend); with jax live, single-process
+    short-circuits; the multi-process-CPU test topology degrades to
+    local rather than crash the loop it is watching (XLA's CPU backend
+    cannot run multiprocess computations — real pods are TPU/GPU); and
+    on a real pod the gather is **wall-bounded**
+    (``TPUFRAME_FLEET_TIMEOUT_S``, default 60 s): a dead peer degrades
+    the ladder to local with one ``fault/peer_lost`` event instead of
+    stalling every healthy survivor's step boundary indefinitely."""
+    if _FLEET_DEGRADED:
+        return [float(value)]
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return [float(value)]
+    if jax.process_count() == 1 or jax.default_backend() == "cpu":
+        return [float(value)]
+    return _bounded_gather(float(value))
 
 
 class StragglerMonitor:

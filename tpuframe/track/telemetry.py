@@ -94,6 +94,7 @@ OBSERVABILITY_ENV_VARS = (
     "TPUFRAME_STRAGGLER_STEPS",
     "TPUFRAME_STRAGGLER_FACTOR",
     "TPUFRAME_PREEMPT_SIGNALS",
+    "TPUFRAME_FLEET_TIMEOUT_S",
 )
 
 

@@ -491,6 +491,7 @@ class Trainer:
                     "batches_seen": self.batches_seen,
                     "samples_seen": self.samples_seen,
                     "preempted": True,
+                    "global_batch": self.train_dataloader.global_batch_size,
                 }
                 if (
                     self._train_prefetcher is not None
@@ -517,7 +518,7 @@ class Trainer:
                 with tele.span(
                     "fault/preempt_checkpoint", step=self.batches_seen
                 ), tele.guard("ckpt/save"):
-                    path = intra.save(self.state, meta=meta)
+                    path = intra.save(self.state, meta=meta, plan=self.plan)
                     intra.wait()  # synchronous: the machine is going away
         # no counter here: fault/preempt_notices counted at the watcher,
         # fault/preemptions at the supervisor's restart — incrementing a
@@ -596,7 +597,15 @@ class Trainer:
         """Background body: a failed precompile must degrade to today's
         lazy-compile behavior, never take the fit down."""
         tele = get_telemetry()
-        report: dict[str, Any] = {"steps": [], "wall_s": 0.0}
+        # precompiles are keyed on the plan: after an elastic shrink the
+        # same batch signature lowers a DIFFERENT program (survivor mesh,
+        # rebound shardings), and the label must attribute those compiles
+        # to the rebound plan rather than look like cache misses of the
+        # old one
+        plan_sig = self.plan.signature()
+        report: dict[str, Any] = {
+            "steps": [], "wall_s": 0.0, "plan_signature": plan_sig,
+        }
         t0 = time.perf_counter()
         targets = [("train", self._train_step, True)]
         if self.eval_dataloader is not None:
@@ -613,7 +622,8 @@ class Trainer:
                 entry["signature"] = format_signature(sig)
                 t1 = time.perf_counter()
                 compiled = precompile_step(
-                    fn, self.state, template, label=f"precompile/{kind}"
+                    fn, self.state, template,
+                    label=f"precompile/{kind}@{plan_sig}",
                 )
                 entry["wall_s"] = round(time.perf_counter() - t1, 6)
                 # arm the guard even when direct dispatch isn't possible
@@ -805,6 +815,25 @@ class Trainer:
                 # a mid-epoch snapshot carries the loader position;
                 # applied after _run_epoch's set_epoch rewind
                 self._pending_loader_state = restored_meta.get("loader_state")
+                # the data-order contract across an elastic resize: the
+                # loader position above counts GLOBAL batches, so the
+                # global batch must survive the shrink unchanged — a
+                # resized world re-splits it (per-process batch x
+                # processes x grad-accum), never changes the product.
+                # Misconfiguration is FATAL (ValueError): retrying would
+                # replay/skip samples on every attempt.
+                saved_gb = restored_meta.get("global_batch")
+                cur_gb = getattr(self.train_dataloader, "global_batch_size", None)
+                if saved_gb and cur_gb and int(saved_gb) != int(cur_gb):
+                    raise ValueError(
+                        f"restored checkpoint was trained at global batch "
+                        f"{saved_gb} but this loader produces {cur_gb}: a "
+                        "world resize must preserve the global batch to "
+                        "keep the checkpointed loader position meaningful "
+                        "— re-derive the per-process split with "
+                        "tpuframe.launch.rederive_batch_split(global_batch="
+                        f"{saved_gb}, dp_size={self.plan.dp_size})"
+                    )
 
         if self.precompile_enabled:
             # background AOT warm-start, overlapped with the epoch's
@@ -857,7 +886,9 @@ class Trainer:
                             "epoch": self.epoch + 1,
                             "batches_seen": self.batches_seen,
                             "samples_seen": self.samples_seen,
+                            "global_batch": self.train_dataloader.global_batch_size,
                         },
+                        plan=self.plan,
                     )
                     result.checkpoint = str(ckpt_path)
                     # An epoch-end save supersedes any mid-epoch snapshot
@@ -1008,7 +1039,9 @@ class Trainer:
                             "batches_seen": self.batches_seen,
                             "samples_seen": self.samples_seen,
                             "loader_state": snap,
+                            "global_batch": self.train_dataloader.global_batch_size,
                         },
+                        plan=self.plan,
                     )
             # step boundary = the preemption exit point: the step is the
             # atomic unit of progress, so a SIGTERM/maintenance notice is
