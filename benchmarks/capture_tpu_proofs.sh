@@ -151,6 +151,14 @@ run bench_fault.json           300  python benchmarks/bench_fault.py
 # rung above the long tail
 run bench_fault_shrink.json    300  python benchmarks/bench_fault.py --shrink
 
+# divergence rung: seeded NaN window -> on-device detect + skip ->
+# Divergence -> rollback to the last HEALTHY committed step -> perturbed
+# re-entry — on the TPU host this prices the sentinel's fused per-step
+# check (the committed <=2%-of-step-time claim, off-vs-on A/B medians)
+# and the real rollback recovery split (FAULT.md "Divergence &
+# rollback"); rides with the fault rungs above the long tail
+run bench_fault_divergence.json 300 python benchmarks/bench_fault.py --divergence
+
 # fleet-analysis rung: an instrumented fit analyzes its own telemetry
 # (cross-rank merge -> skew table -> Perfetto trace) and commits the
 # on-chip step_time block that `python -m tpuframe.track analyze

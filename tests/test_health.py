@@ -1,0 +1,756 @@
+"""Training-health sentinel acceptance: on-device NaN/spike detection,
+branch-free skip-step, EWMA discipline, checkpoint health stamps,
+divergence rollback to the last healthy step, loader bad-sample
+quarantine, checkpoint save retry."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpuframe.ckpt import Checkpointer, latest_step
+from tpuframe.ckpt.checkpoint import (
+    COMMIT_MARKERS,
+    healthy_steps,
+    latest_healthy_step,
+    read_health,
+    rollback_to_last_healthy,
+)
+from tpuframe.data import DataLoader, SyntheticImageDataset
+from tpuframe.fault import (
+    ChaosError,
+    ChaosPlan,
+    Divergence,
+    FailureClass,
+    HealthPolicy,
+    NaNAt,
+    RaiseAt,
+    RestartPolicy,
+    SpikeAt,
+    Supervisor,
+    classify_failure,
+    recovery_directive,
+    reset_recovery,
+)
+from tpuframe.fault import health as health_mod
+from tpuframe.models import MnistNet
+from tpuframe.train import Callback, Trainer
+from tpuframe.train.state import create_train_state
+from tpuframe.train.step import make_grad_accum_step, make_train_step
+from tpuframe.track.telemetry import get_telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_recovery_state():
+    """One test's divergence escalations must not leak into the next."""
+    reset_recovery()
+    yield
+    reset_recovery()
+
+
+def _ds(n=128):
+    return SyntheticImageDataset(
+        n=n, image_size=28, channels=1, num_classes=4, seed=0
+    )
+
+
+def _loader(ds, **kw):
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 3)
+    # float transfer: the NaN/spike injectors poison host batches, and
+    # uint8 can't represent the poison (the injector raises on it)
+    kw.setdefault("transfer_dtype", "float32")
+    return DataLoader(ds, **kw)
+
+
+def _trainer(ds, ckpt=None, **kw):
+    kw.setdefault("max_duration", "2ep")
+    kw.setdefault("eval_interval", 0)
+    kw.setdefault("log_interval", 0)
+    loader_kw = kw.pop("loader_kw", {})
+    return Trainer(
+        MnistNet(num_classes=4),
+        train_dataloader=_loader(ds, **loader_kw),
+        checkpointer=ckpt,
+        **kw,
+    )
+
+
+def _state(seed=0):
+    model = MnistNet(num_classes=4)
+    return create_train_state(
+        model,
+        jax.random.PRNGKey(seed),
+        np.zeros((1, 28, 28, 1), np.float32),
+        __import__("optax").adam(1e-3),
+    )
+
+
+def _batch(nan=False, scale=1.0, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    img = rng.normal(size=(n, 28, 28, 1)).astype(np.float32) * scale
+    if nan:
+        img[0] = np.nan
+    return {"image": img, "label": (np.arange(n) % 4).astype(np.int32)}
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(tree))]
+
+
+def _hm(metrics):
+    """Named health columns from the step's packed ``health_stats`` leaf."""
+    return health_mod.unpack_health_stats(jax.device_get(metrics["health_stats"]))
+
+
+# -- policy resolution --------------------------------------------------------
+
+
+class TestPolicyResolution:
+    def test_default_on_and_env_off(self, monkeypatch):
+        monkeypatch.delenv("TPUFRAME_HEALTH", raising=False)
+        assert health_mod.resolve_policy(None) is not None
+        monkeypatch.setenv("TPUFRAME_HEALTH", "0")
+        assert health_mod.resolve_policy(None) is None
+        assert health_mod.resolve_policy(True) is not None  # explicit wins
+        assert health_mod.resolve_policy(False) is None
+
+    def test_env_thresholds(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_HEALTH_WINDOW", "7")
+        monkeypatch.setenv("TPUFRAME_HEALTH_MAX_BAD", "3")
+        monkeypatch.setenv("TPUFRAME_HEALTH_SPIKE_FACTOR", "2.5")
+        pol = HealthPolicy.from_env()
+        assert (pol.window, pol.max_bad, pol.spike_factor) == (7, 3, 2.5)
+
+    def test_instance_passthrough_and_bogus(self):
+        pol = HealthPolicy(window=3)
+        assert health_mod.resolve_policy(pol) is pol
+        with pytest.raises(ValueError, match="health must be"):
+            health_mod.resolve_policy("yes")
+        with pytest.raises(ValueError, match="window"):
+            HealthPolicy(window=0)
+
+
+# -- injectors ----------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestPoisonInjectors:
+    def test_scheduled_seeding_is_deterministic(self):
+        steps = [
+            ChaosPlan.scheduled(
+                11, max_step=50, sites={"batch": NaNAt}
+            ).injectors[0].step
+            for _ in range(2)
+        ]
+        assert steps[0] == steps[1]
+        other = ChaosPlan.scheduled(
+            12, max_step=50, sites={"batch": NaNAt}
+        ).injectors[0].step
+        # a different seed draws a different schedule (50 choices)
+        assert isinstance(other, int) and 1 <= other < 50
+
+    def test_scheduled_instance_keeps_knobs(self):
+        plan = ChaosPlan.scheduled(
+            5, max_step=40, sites={"batch": NaNAt(times=3)}
+        )
+        inj = plan.injectors[0]
+        assert inj.site == "batch" and inj.times == 3
+
+    def test_poison_window_matches_consecutive_steps(self):
+        inj = NaNAt(step=5, times=3)
+        hits = [s for s in range(12) if inj.matches("batch", s)]
+        assert hits == [5, 6, 7]  # the consecutive poison window [5, 8)
+        assert not inj.matches("loader", 5)
+
+    def test_nan_poisons_float_batch_in_place(self):
+        img = np.zeros((4, 8, 8, 1), np.float32)
+        NaNAt(step=None).fire({"images": img})
+        assert np.isnan(img[0]).all() and not np.isnan(img[1]).any()
+
+    def test_spike_scales_batch(self):
+        img = np.ones((4, 8, 8, 1), np.float32)
+        SpikeAt(step=None, scale=100.0).fire({"images": img})
+        assert float(img[0, 0, 0, 0]) == 100.0
+
+    def test_uint8_and_siteless_fire_raise_loudly(self):
+        # ValueError on purpose: classify_failure maps it to FATAL, so a
+        # misconfigured drill fails fast instead of burning restarts
+        with pytest.raises(ValueError, match="uint8") as ei:
+            NaNAt().fire({"images": np.zeros((2, 4, 4, 1), np.uint8)})
+        assert classify_failure(ei.value) is FailureClass.FATAL
+        with pytest.raises(ValueError, match="no host image batch"):
+            SpikeAt().fire({"step": 3})
+
+
+# -- the on-device verdict + skip ---------------------------------------------
+
+
+class TestSkipStep:
+    @pytest.fixture(autouse=True)
+    def _no_persistent_compile_cache(self):
+        """These tests drive raw jitted steps (fresh jit instance per
+        test) with donated state.  On jax 0.4.37 CPU a persistent-cache
+        HIT hands back a deserialized executable whose donation/aliasing
+        handling is broken — outputs can come back as the stale donated
+        inputs (the same defect family PR 5's restore ``_rebuffer``
+        works around).  An earlier test in the session may have enabled
+        the process-wide cache (any Supervisor does); disable it here so
+        the probe measures the step, not jax's cache bug."""
+        from tpuframe.compile import cache as compile_cache
+
+        prev = compile_cache.enabled_dir()
+        compile_cache.disable()
+        yield
+        if prev:
+            compile_cache.enable(prev)
+
+    def test_nonfinite_step_is_bit_identical_noop(self):
+        pol = HealthPolicy(warmup_steps=1)
+        step = make_train_step(health=pol)
+        state = _state()
+        before_p = _leaves(state.params)
+        before_o = _leaves(state.opt_state)
+        new_state, metrics = step(state, _batch(nan=True))
+        hm = _hm(metrics)
+        assert hm["health_bad"] == 1.0
+        assert hm["health_nonfinite"] == 1.0
+        # zeroed contributions: a NaN loss must not poison window sums
+        assert float(metrics["loss_sum"]) == 0.0
+        assert float(metrics["count"]) == 0.0
+        for a, b in zip(before_p, _leaves(new_state.params)):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(before_o, _leaves(new_state.opt_state)):
+            np.testing.assert_array_equal(a, b)
+        # the step still advances: the loader position stays aligned
+        assert int(jax.device_get(new_state.step)) == 1
+        hs = jax.device_get(new_state.health)
+        assert float(hs["bad_steps"]) == 1.0
+        assert float(hs["last_bad_step"]) == 0.0
+
+    def test_good_step_updates_and_warms_ewma(self):
+        pol = HealthPolicy(warmup_steps=1)
+        step = make_train_step(health=pol)
+        state = _state()
+        before = _leaves(state.params)
+        new_state, metrics = step(state, _batch())
+        assert _hm(metrics)["health_bad"] == 0.0
+        assert any(
+            not np.array_equal(a, b)
+            for a, b in zip(before, _leaves(new_state.params))
+        )
+        hs = jax.device_get(new_state.health)
+        assert float(hs["good_steps"]) == 1.0
+        assert float(hs["loss_ewma"]) > 0.0
+
+    def test_bad_step_never_moves_the_ewma(self):
+        pol = HealthPolicy(warmup_steps=1)
+        step = make_train_step(health=pol)
+        state = _state()
+        state, _ = step(state, _batch())
+        ewma = float(jax.device_get(state.health)["loss_ewma"])
+        state, metrics = step(state, _batch(nan=True, seed=1))
+        assert _hm(metrics)["health_bad"] == 1.0
+        assert float(jax.device_get(state.health)["loss_ewma"]) == ewma
+
+    def test_spike_detected_after_warmup_only(self):
+        pol = HealthPolicy(warmup_steps=2, spike_factor=3.0)
+        step = make_train_step(health=pol)
+        state = _state()
+        # during warmup a blown-up batch is NOT judged (EWMA unseeded)
+        _, metrics = step(state, _batch(scale=500.0))
+        assert _hm(metrics)["health_spike"] == 0.0
+        state = _state(seed=1)
+        for i in range(3):  # warm the EWMA on sane batches
+            state, m = step(state, _batch(seed=i))
+            assert _hm(m)["health_bad"] == 0.0
+        before = _leaves(state.params)
+        state, metrics = step(state, _batch(scale=500.0, seed=9))
+        hm = _hm(metrics)
+        assert hm["health_spike"] == 1.0
+        assert hm["health_nonfinite"] == 0.0
+        for a, b in zip(before, _leaves(state.params)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_grad_accum_super_batch_skips_whole(self):
+        pol = HealthPolicy(warmup_steps=1)
+        step = make_grad_accum_step(2, health=pol)
+        state = _state()
+        before = _leaves(state.params)
+        b = _batch(n=8)
+        b = {k: v.reshape((2, 4) + v.shape[1:]) for k, v in b.items()}
+        b["image"][1, 0] = np.nan  # second microbatch poisoned
+        new_state, metrics = step(state, b)
+        assert _hm(metrics)["health_bad"] == 1.0
+        for a, bb in zip(before, _leaves(new_state.params)):
+            np.testing.assert_array_equal(a, bb)
+
+    def test_health_off_keeps_plain_metrics(self):
+        step = make_train_step()
+        _, metrics = step(_state(), _batch())
+        assert "health_stats" not in metrics
+
+
+# -- checkpoint health stamps + rollback --------------------------------------
+
+
+def _fake_step(tmp_path, step, healthy=None):
+    """A committed on-disk step dir with an optional health stamp —
+    rollback is stdlib file surgery, so no orbax needed to test it."""
+    d = tmp_path / str(step)
+    (d / "meta").mkdir(parents=True)
+    (d / COMMIT_MARKERS[0]).write_text("{}")
+    doc = {"meta": {}, "metrics": {}, "topology": None}
+    if healthy is not None:
+        doc["health"] = {"healthy": healthy, "step": step, "bad_steps": 0}
+    (d / "meta" / "metadata").write_text(json.dumps(doc))
+
+
+class TestHealthStampsAndRollback:
+    def test_stamp_healthy_logic(self):
+        pol = HealthPolicy(window=4)
+        hs = {"loss_ewma": 1.0, "good_steps": 10.0, "bad_steps": 2.0,
+              "last_bad_step": 3.0, "grad_norm": float("inf")}
+        stamp = health_mod.health_stamp(hs, step=10, policy=pol)
+        assert stamp["healthy"] is True  # 10 - 3 > 4
+        assert stamp["grad_norm"] is None  # non-finite sanitized for JSON
+        stamp = health_mod.health_stamp(hs, step=5, policy=pol)
+        assert stamp["healthy"] is False  # 5 - 3 <= 4
+        never = dict(hs, last_bad_step=-1.0)
+        assert health_mod.health_stamp(never, 0, pol)["healthy"] is True
+
+    def test_healthy_steps_and_rollback(self, tmp_path):
+        _fake_step(tmp_path, 2, healthy=True)
+        _fake_step(tmp_path, 4, healthy=None)  # pre-sentinel: counts healthy
+        _fake_step(tmp_path, 6, healthy=False)
+        _fake_step(tmp_path, 8, healthy=False)
+        assert healthy_steps(tmp_path) == [2, 4]
+        assert latest_healthy_step(tmp_path) == 4
+        rb = rollback_to_last_healthy(tmp_path)
+        assert rb == {"to_step": 4, "quarantined": [6, 8]}
+        assert latest_step(tmp_path) == 4
+        q = sorted(os.listdir(tmp_path / "_quarantine"))
+        assert q == ["6", "8"]
+        # already at the healthy frontier: silent no-op
+        assert rollback_to_last_healthy(tmp_path)["quarantined"] == []
+
+    def test_rollback_with_no_healthy_step_clears_all(self, tmp_path):
+        _fake_step(tmp_path, 3, healthy=False)
+        rb = rollback_to_last_healthy(tmp_path)
+        assert rb["to_step"] is None and rb["quarantined"] == [3]
+        assert latest_step(tmp_path) is None
+
+    def test_save_embeds_stamp_and_restore_healthy_only(self, tmp_path):
+        ck = Checkpointer(tmp_path / "ck")
+        try:
+            state = _state()
+            ck.save(state, step=1,
+                    health={"healthy": True, "step": 1, "bad_steps": 0})
+            ck.save(state, step=2,
+                    health={"healthy": False, "step": 2, "bad_steps": 3})
+            assert read_health(ck.directory, 1)["healthy"] is True
+            assert ck.health_for(2)["bad_steps"] == 3
+            assert ck.latest_step() == 2
+            assert ck.latest_healthy_step() == 1
+            _, meta = ck.restore(state, healthy_only=True)
+            # landed on step 1, not the newer unhealthy 2
+            restored, _ = ck.restore(state, healthy_only=True)
+            assert int(jax.device_get(restored.step)) == int(
+                jax.device_get(state.step)
+            )
+        finally:
+            ck.close()
+
+    @pytest.mark.chaos
+    def test_save_retries_transient_io(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_CKPT_SAVE_RETRIES", "2")
+        reg = get_telemetry().registry
+        n0 = reg.counter("ckpt/save_retries").value
+        ck = Checkpointer(tmp_path / "ck")
+        try:
+            with ChaosPlan([RaiseAt("ckpt/save")]).active():
+                ck.save(_state(), step=1)
+            assert ck.latest_step() == 1  # the flake was absorbed
+            assert reg.counter("ckpt/save_retries").value == n0 + 1
+        finally:
+            ck.close()
+
+    @pytest.mark.chaos
+    def test_save_retry_budget_exhausts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_CKPT_SAVE_RETRIES", "1")
+        ck = Checkpointer(tmp_path / "ck")
+        try:
+            with ChaosPlan([RaiseAt("ckpt/save", times=5)]).active():
+                with pytest.raises(ChaosError):
+                    ck.save(_state(), step=1)
+        finally:
+            ck.close()
+
+
+# -- supervisor: DIVERGENCE class ---------------------------------------------
+
+
+class TestDivergenceClass:
+    def test_classification(self):
+        assert classify_failure(Divergence("x")) is FailureClass.DIVERGENCE
+        assert classify_failure(RuntimeError("x")) is FailureClass.RETRYABLE
+
+    def test_budget_and_escalation(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_HEALTH_LR_BACKOFF", "0.5")
+        monkeypatch.setenv("TPUFRAME_HEALTH_SKIP_BATCHES", "3")
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise Divergence("still diverging", step=7)
+
+        sup = Supervisor(
+            RestartPolicy(max_divergences=2, max_restarts=0),
+            sleep=lambda s: None,
+        )
+        with pytest.raises(Divergence):
+            sup.run(fn)
+        # 1 initial + 2 rollback re-entries, then the budget surfaces it
+        assert len(calls) == 3
+        assert sup.divergences == 3 and sup.retries == 0
+        d = recovery_directive()
+        # two escalations applied (the third exceeded the budget)
+        assert d.lr_scale == pytest.approx(0.25)
+        assert d.skip_batches == 3 and d.divergences == 2
+
+    def test_run_resets_stale_directive(self):
+        health_mod.escalate_recovery(HealthPolicy(lr_backoff=0.1))
+        assert recovery_directive().lr_scale == pytest.approx(0.1)
+        Supervisor(RestartPolicy(max_restarts=0)).run(lambda: "ok")
+        assert recovery_directive().lr_scale == 1.0
+
+    def test_programmatic_policy_rides_the_divergence(self, monkeypatch):
+        """A Trainer built with HealthPolicy(lr_backoff=, skip_batches=)
+        and NO env knobs must shape the recovery — the policy rides the
+        raised Divergence to the supervisor's escalation."""
+        monkeypatch.delenv("TPUFRAME_HEALTH_LR_BACKOFF", raising=False)
+        monkeypatch.delenv("TPUFRAME_HEALTH_SKIP_BATCHES", raising=False)
+        pol = HealthPolicy(lr_backoff=0.9, skip_batches=5)
+        raised = []
+
+        def fn():
+            if not raised:
+                raised.append(1)
+                raise Divergence("spike", step=3, policy=pol)
+            return "ok"
+
+        Supervisor(
+            RestartPolicy(max_divergences=1, max_restarts=0),
+            sleep=lambda s: None,
+        ).run(fn)
+        d = recovery_directive()
+        assert d.lr_scale == pytest.approx(0.9)  # not the env default 0.5
+        assert d.skip_batches == 5
+
+    def test_skip_batches_consumed_once(self):
+        """The data-order skip applies to the FIRST post-rollback fit
+        only; a later unrelated restart must not re-skip healthy
+        batches.  lr_scale is deliberately sticky."""
+        health_mod.escalate_recovery(HealthPolicy(lr_backoff=0.5,
+                                                  skip_batches=4))
+        assert health_mod.consume_skip_batches() == 4
+        assert health_mod.consume_skip_batches() == 0
+        assert recovery_directive().lr_scale == pytest.approx(0.5)
+
+    def test_skip_applies_without_a_restore(self):
+        """The perturbation half of divergence recovery must not depend
+        on there being something to roll back to: an armed skip advances
+        the loader even on a checkpointer-less (or all-quarantined,
+        fresh-start) re-entry."""
+        health_mod.escalate_recovery(HealthPolicy(skip_batches=2))
+        seen = []
+
+        class Count(Callback):
+            def on_step_end(self, trainer):
+                seen.append(trainer.batches_seen)
+
+        tr = _trainer(_ds(16 * 4), max_duration="1ep",
+                      health=HealthPolicy(skip_batches=2),
+                      callbacks=[Count()])
+        tr.fit()
+        # 4-batch epoch, first 2 skipped by the directive
+        assert len(seen) == 2
+        assert health_mod.consume_skip_batches() == 0  # consumed
+
+    def test_spike_margin_floors_near_zero_loss(self):
+        """A converged run (EWMA ~1e-4) must not read routine
+        batch-to-batch ratios as spikes: the default absolute margin
+        floors the relative test."""
+        pol = HealthPolicy()  # defaults: factor 4.0, margin 0.05
+        import jax.numpy as jnp
+        hstate = {
+            "loss_ewma": jnp.float32(1e-4),
+            "good_steps": jnp.float32(pol.warmup_steps + 1),
+            "bad_steps": jnp.float32(0.0),
+            "last_bad_step": jnp.float32(-1.0),
+            "grad_norm": jnp.float32(0.0),
+        }
+        grads = {"w": jnp.ones((4,), jnp.float32)}
+        # 20x the EWMA but under the margin: routine convergence noise
+        bad, _, _ = health_mod.health_verdict(
+            jnp.float32(2e-3), grads, hstate, jnp.int32(30), pol
+        )
+        assert not bool(bad)
+        # a real blow-up clears the margin regardless of scale
+        bad, _, _ = health_mod.health_verdict(
+            jnp.float32(1.0), grads, hstate, jnp.int32(30), pol
+        )
+        assert bool(bad)
+
+
+# -- loader bad-sample quarantine ---------------------------------------------
+
+
+class _PoisonedDataset:
+    """Raises a decode-style error for chosen indices."""
+
+    def __init__(self, n=64, bad=(), exc=ValueError):
+        self.inner = _ds(n)
+        self.bad = frozenset(bad)
+        self.exc = exc
+
+    def __len__(self):
+        return len(self.inner)
+
+    def __getitem__(self, idx):
+        if idx in self.bad:
+            raise self.exc(f"corrupt JPEG entropy data at sample {idx}")
+        return self.inner[idx]
+
+
+class TestBadSampleQuarantine:
+    def test_skip_and_count(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_MAX_BAD_SAMPLES", "8")
+        reg = get_telemetry().registry
+        n0 = reg.counter("data/bad_samples").value
+        dl = DataLoader(_PoisonedDataset(64, bad=(3, 17)), batch_size=16,
+                        process_index=0, process_count=1)
+        batches = list(dl)
+        assert len(batches) == 4  # the epoch survived
+        assert all(b[0].shape[0] == 16 for b in batches)  # padded back
+        assert reg.counter("data/bad_samples").value == n0 + 2
+        ev = [e for e in get_telemetry().recent_events(100)
+              if e["name"] == "data/bad_sample"]
+        assert {e["index"] for e in ev[-2:]} == {3, 17}
+
+    def test_eval_mask_drops_bad_rows(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_MAX_BAD_SAMPLES", "8")
+        dl = DataLoader(_PoisonedDataset(32, bad=(5,)), batch_size=16,
+                        drop_last=False, process_index=0, process_count=1)
+        batches = list(dl)
+        # the pad row standing in for the bad sample is masked invalid
+        total_valid = sum(int(b[2].sum()) for b in batches)
+        assert total_valid == 31
+
+    def test_cap_exceeded_raises(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_MAX_BAD_SAMPLES", "1")
+        dl = DataLoader(_PoisonedDataset(64, bad=(1, 2, 3)), batch_size=16,
+                        process_index=0, process_count=1)
+        with pytest.raises(RuntimeError, match="TPUFRAME_MAX_BAD_SAMPLES"):
+            list(dl)
+
+    def test_bug_exceptions_still_raise(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_MAX_BAD_SAMPLES", "8")
+        dl = DataLoader(_PoisonedDataset(64, bad=(2,), exc=TypeError),
+                        batch_size=16, process_index=0, process_count=1)
+        with pytest.raises(TypeError):
+            list(dl)
+
+    def test_thread_workers_skip_too(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_MAX_BAD_SAMPLES", "8")
+        dl = DataLoader(_PoisonedDataset(64, bad=(9,)), batch_size=16,
+                        num_workers=2, process_index=0, process_count=1)
+        assert len(list(dl)) == 4
+
+
+# -- the Trainer ladder -------------------------------------------------------
+
+
+def _events(n=500):
+    return get_telemetry().recent_events(n)
+
+
+@pytest.mark.chaos
+class TestTrainerLadder:
+    def test_nan_step_skipped_and_counted(self):
+        reg = get_telemetry().registry
+        n0 = reg.counter("health/bad_steps").value
+        tr = _trainer(_ds(64), max_duration="1ep",
+                      health=HealthPolicy(window=2, max_bad=99,
+                                          warmup_steps=2))
+        with ChaosPlan([NaNAt(step=1)]).active():
+            res = tr.fit()
+        assert res.metrics["health_bad_steps"] == 1.0
+        assert reg.counter("health/bad_steps").value == n0 + 1
+        assert float(jax.device_get(tr.state.health)["last_bad_step"]) == 1.0
+
+    def test_divergence_raised_at_window(self):
+        tr = _trainer(_ds(128), max_duration="1ep",
+                      health=HealthPolicy(window=4, max_bad=2,
+                                          warmup_steps=1))
+        with ChaosPlan([NaNAt(step=2, times=3)]).active():
+            with pytest.raises(Divergence) as ei:
+                tr.fit()
+        assert ei.value.bad_in_window >= 2
+        names = [e["name"] for e in _events()]
+        assert "health/divergence" in names
+
+    def test_sentinel_off_env(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_HEALTH", "0")
+        tr = _trainer(_ds(32), max_duration="1ep")
+        assert tr.health is None
+        res = tr.fit()
+        assert "health_bad_steps" not in res.metrics
+
+    def test_acceptance_nan_skip_escalate_rollback_complete(
+        self, tmp_path, monkeypatch
+    ):
+        """THE story: seeded NaN window => bad-step skips => Divergence
+        => supervisor rolls back to the last *healthy* committed step =>
+        perturbed re-entry => run completes at full step count with
+        final loss within tolerance of an uninjected run — zero human
+        edits, zero recompiles."""
+        monkeypatch.setenv("TPUFRAME_HEALTH_LR_BACKOFF", "1.0")
+        monkeypatch.setenv("TPUFRAME_HEALTH_SKIP_BATCHES", "0")
+        pol = HealthPolicy(window=4, max_bad=2, warmup_steps=2,
+                           lr_backoff=1.0)
+        ds = _ds(16 * 8)
+        reg = get_telemetry().registry
+        recompiles0 = reg.counter("compile/recompiles").value
+
+        # reference: the same schedule, no injection
+        ref = _trainer(ds, max_duration="2ep", health=pol)
+        ref_res = ref.fit()
+        ref_loss = ref_res.metrics["train_loss"]
+
+        ckpt_dir = str(tmp_path / "ck")
+        resumed: list[int] = []
+        expected_resume: list[int] = []
+
+        class Probe(Callback):
+            def on_fit_start(self, trainer) -> None:
+                resumed.append(int(jax.device_get(trainer.init_state().step)))
+
+        def on_restart(attempt, error):
+            # called AFTER the rollback: the dirs' newest committed step
+            # IS the healthy frontier the next attempt must land on
+            expected_resume.append(max(
+                latest_step(ckpt_dir) or 0,
+                latest_step(ckpt_dir + "_intra") or 0,
+            ))
+
+        def attempt():
+            ck = Checkpointer(ckpt_dir)
+            try:
+                tr = _trainer(
+                    ds, ck, max_duration="2ep", health=pol,
+                    checkpoint_interval_batches=2, callbacks=[Probe()],
+                )
+                res = tr.fit()
+                return int(jax.device_get(tr.state.step)), res
+            finally:
+                ck.close()
+
+        # seeded poison window pinned at step 9 (after the epoch-1-end
+        # save at step 8 exists as a healthy rollback target): the
+        # interval save at step 10 commits INSIDE the window, so it is
+        # stamped unhealthy and the rollback has real surgery to do —
+        # a window starting past the last save would make the rollback
+        # a silent no-op (divergence preempts the next doomed save)
+        plan = ChaosPlan.scheduled(
+            23, sites={"batch": NaNAt(times=3)}, min_step=9, max_step=9,
+        )
+        sup = Supervisor(
+            RestartPolicy(max_restarts=0, max_divergences=2,
+                          backoff_base_s=0.0),
+            checkpoint_dir=ckpt_dir,
+            on_restart=on_restart,
+        )
+        with plan.active():
+            final_step, res = sup.run(attempt)
+
+        assert sup.divergences == 1 and sup.retries == 0
+        assert final_step == 16  # the full 2-epoch schedule completed
+        assert plan.fired_count() >= 2
+        # rollback landed exactly on the last healthy committed step:
+        # the unhealthy-stamped step-10 interval snapshot (the `_intra`
+        # sibling keeps the newest) is quarantined, the epoch-end step-8
+        # save in the main dir wins
+        assert len(resumed) == 2
+        assert resumed[1] == expected_resume[0] == 8
+        intra = ckpt_dir + "_intra"
+        assert os.listdir(os.path.join(intra, "_quarantine")) == ["10"]
+        names = [e["name"] for e in _events(800)]
+        assert "health/bad_step" in names
+        assert "health/divergence" in names
+        # scope the rollback proof to THIS run's directories — the
+        # shared telemetry log also holds earlier tests' rollback events
+        rollbacks = [e for e in _events(800)
+                     if e["name"] == "fault/rollback"
+                     and e.get("directory", "").startswith(ckpt_dir)]
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["directory"] == intra
+        assert rollbacks[0]["quarantined"] == [10]
+        # the sentinel + rollback never perturbed the compiled programs
+        assert reg.counter("compile/recompiles").value == recompiles0
+        # and the recovered run converged like the uninjected one
+        loss = res.metrics["train_loss"]
+        assert loss == pytest.approx(ref_loss, rel=0.5, abs=0.25)
+
+    def test_unhealthy_snapshot_stamp(self, tmp_path):
+        """A snapshot written inside the poison window carries an
+        unhealthy stamp — the record rollback selects on."""
+        ck = Checkpointer(str(tmp_path / "ck"))
+        try:
+            tr = _trainer(
+                _ds(64), ck, max_duration="1ep",
+                checkpoint_interval_batches=2,
+                # no epoch-end save (interval 2 over 1 epoch): the
+                # snapshot must survive for inspection instead of being
+                # superseded-and-deleted at epoch end
+                checkpoint_interval=2,
+                health=HealthPolicy(window=8, max_bad=99, warmup_steps=1),
+            )
+            with ChaosPlan([NaNAt(step=1)]).active():
+                tr.fit()
+            intra = str(tmp_path / "ck") + "_intra"
+            snap = latest_step(intra)
+            assert snap == 2  # snapshot right after the poisoned step
+            stamp = read_health(intra, snap)
+            assert stamp is not None
+            assert stamp["healthy"] is False  # bad step 1 inside window
+            assert stamp["bad_steps"] == 1
+            assert stamp["last_bad_step"] == 1
+        finally:
+            ck.close()
+
+
+# -- doctor health section -----------------------------------------------------
+
+
+class TestDoctorHealth:
+    def test_section_thresholds_and_stamp(self, tmp_path):
+        from tpuframe.doctor import health_section
+
+        sec = health_section()
+        assert sec["enabled"] in (True, False)
+        assert sec["thresholds"]["window"] >= 1
+
+    def test_malformed_env_reported_not_raised(self, monkeypatch):
+        """The doctor exists to diagnose broken environments — a bogus
+        TPUFRAME_HEALTH_WINDOW must show up IN the report, not crash it."""
+        from tpuframe.doctor import health_section
+
+        monkeypatch.setenv("TPUFRAME_HEALTH_WINDOW", "0")
+        sec = health_section()
+        assert "error" in sec["thresholds"]
+        assert "window" in sec["thresholds"]["error"]
+        assert sec["env"]["TPUFRAME_HEALTH_WINDOW"] == "0"

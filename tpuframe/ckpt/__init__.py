@@ -17,11 +17,15 @@ TPU-native replacement for the reference's three checkpoint styles
 from tpuframe.ckpt.checkpoint import (
     Checkpointer,
     best_checkpoint_path,
+    healthy_steps,
     is_committed,
+    latest_healthy_step,
     latest_step,
     load_pytree,
     quarantine_torn_steps,
+    read_health,
     read_manifest,
+    rollback_to_last_healthy,
     save_pytree,
     topology_manifest,
     valid_steps,
@@ -30,11 +34,15 @@ from tpuframe.ckpt.checkpoint import (
 __all__ = [
     "Checkpointer",
     "best_checkpoint_path",
+    "healthy_steps",
     "is_committed",
+    "latest_healthy_step",
     "latest_step",
     "load_pytree",
     "quarantine_torn_steps",
+    "read_health",
     "read_manifest",
+    "rollback_to_last_healthy",
     "save_pytree",
     "topology_manifest",
     "valid_steps",

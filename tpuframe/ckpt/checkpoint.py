@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Mapping
 
 import jax
@@ -36,6 +37,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from tpuframe.fault import chaos
+from tpuframe.fault.health import _env_int
 from tpuframe.track.telemetry import get_telemetry
 
 _DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
@@ -89,6 +91,23 @@ def latest_step(directory: str | os.PathLike) -> int | None:
     return steps[-1] if steps else None
 
 
+def _quarantine_move(directory: str, entry: str) -> str:
+    """Move ``<directory>/<entry>`` into ``<directory>/_quarantine/``
+    (collision-suffixed — a step can be quarantined twice across
+    restarts).  Moved aside, never deleted: quarantined state is
+    evidence and may still be salvageable by hand."""
+    src = os.path.join(directory, entry)
+    qdir = os.path.join(directory, "_quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    dst = os.path.join(qdir, entry)
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = os.path.join(qdir, f"{entry}.{n}")
+    os.rename(src, dst)
+    return dst
+
+
 def quarantine_torn_steps(directory: str | os.PathLike) -> list[str]:
     """Move torn step dirs into ``<directory>/_quarantine/`` (the
     supervisor's pre-resume validation).  Moved aside, never deleted:
@@ -115,14 +134,7 @@ def quarantine_torn_steps(directory: str | os.PathLike) -> list[str]:
         src = os.path.join(directory, e)
         if not (e.isdigit() and os.path.isdir(src)) or is_committed(src):
             continue
-        qdir = os.path.join(directory, "_quarantine")
-        os.makedirs(qdir, exist_ok=True)
-        dst = os.path.join(qdir, e)
-        n = 0
-        while os.path.exists(dst):  # a step torn twice across restarts
-            n += 1
-            dst = os.path.join(qdir, f"{e}.{n}")
-        os.rename(src, dst)
+        dst = _quarantine_move(directory, e)
         moved.append(dst)
         tele.registry.counter("fault/quarantined_steps").inc()
         tele.event("fault/quarantine", step=int(e), src=src, dst=dst)
@@ -168,11 +180,10 @@ def topology_manifest(state: Any, plan: Any = None) -> dict | None:
     }
 
 
-def read_manifest(directory: str | os.PathLike, step: int | None = None) -> dict | None:
-    """The topology manifest of ``step`` (default: latest committed), read
-    straight off the on-disk meta JSON — stdlib-only, so the doctor can
-    print it without touching orbax or a possibly-wedged backend.  None
-    for pre-manifest checkpoints or when no committed step exists."""
+def _read_meta_doc(directory: str | os.PathLike, step: int | None) -> dict | None:
+    """The raw meta JSON doc of ``step`` (default: latest committed),
+    read straight off disk — stdlib-only, doctor-safe against a wedged
+    backend."""
     if step is None:
         step = latest_step(directory)
     if step is None:
@@ -183,7 +194,82 @@ def read_manifest(directory: str | os.PathLike, step: int | None = None) -> dict
             doc = json.load(f)
     except (FileNotFoundError, NotADirectoryError, IsADirectoryError, ValueError):
         return None
-    return doc.get("topology") if isinstance(doc, dict) else None
+    return doc if isinstance(doc, dict) else None
+
+
+def read_manifest(directory: str | os.PathLike, step: int | None = None) -> dict | None:
+    """The topology manifest of ``step`` (default: latest committed), read
+    straight off the on-disk meta JSON — stdlib-only, so the doctor can
+    print it without touching orbax or a possibly-wedged backend.  None
+    for pre-manifest checkpoints or when no committed step exists."""
+    doc = _read_meta_doc(directory, step)
+    return doc.get("topology") if doc else None
+
+
+# -- health records ------------------------------------------------------------
+
+
+def read_health(directory: str | os.PathLike, step: int | None = None) -> dict | None:
+    """The training-health stamp of ``step`` (default: latest committed)
+    — what the Trainer's sentinel wrote next to the topology manifest
+    (loss EWMA, grad norm, bad-step count, ``healthy`` verdict).
+    Stdlib-only like :func:`read_manifest`; None for pre-sentinel
+    checkpoints or when no committed step exists."""
+    doc = _read_meta_doc(directory, step)
+    return doc.get("health") if doc else None
+
+
+def is_healthy(directory: str | os.PathLike, step: int) -> bool:
+    """True unless the step's health stamp explicitly says unhealthy —
+    pre-sentinel checkpoints (no stamp) count healthy, so rollback never
+    strands a run on old-format history."""
+    stamp = read_health(directory, step)
+    return bool((stamp or {}).get("healthy", True))
+
+
+def healthy_steps(directory: str | os.PathLike) -> list[int]:
+    """Committed steps whose health stamp is absent-or-healthy."""
+    return [s for s in valid_steps(directory) if is_healthy(directory, s)]
+
+
+def latest_healthy_step(directory: str | os.PathLike) -> int | None:
+    """Newest committed step rollback may land on (None when every
+    committed step is stamped unhealthy, or none exist)."""
+    steps = healthy_steps(directory)
+    return steps[-1] if steps else None
+
+
+def rollback_to_last_healthy(directory: str | os.PathLike) -> dict:
+    """Divergence rollback: quarantine every committed step NEWER than
+    the newest *healthy* one, so plain auto-resume lands on known-good
+    state instead of the newest (possibly poisoned) save.
+
+    Steps are moved into ``<directory>/_quarantine/`` like torn steps —
+    evidence, never deleted.  When no healthy step exists, every
+    unhealthy-stamped step is quarantined (a fresh start beats resuming
+    into a divergence).  Emits one loud ``fault/rollback`` event +
+    ``fault/rollbacks`` counter when anything moved; a directory already
+    at its healthy frontier is a silent no-op.  Returns
+    ``{"to_step": int | None, "quarantined": [steps]}``.
+    """
+    directory = os.fspath(directory)
+    steps = valid_steps(directory)
+    target = latest_healthy_step(directory)
+    doomed = [s for s in steps if target is None or s > target]
+    moved: list[int] = []
+    for s in doomed:
+        _quarantine_move(directory, str(s))
+        moved.append(s)
+    if moved:
+        tele = get_telemetry()
+        tele.registry.counter("fault/rollbacks").inc()
+        tele.event(
+            "fault/rollback",
+            directory=directory,
+            to_step=target,
+            quarantined=moved,
+        )
+    return {"to_step": target, "quarantined": moved}
 
 
 def _target_topology(abstract: Any) -> dict | None:
@@ -355,6 +441,7 @@ class Checkpointer:
         step: int | None = None,
         force: bool = False,
         plan: Any = None,
+        health: Mapping[str, Any] | None = None,
     ) -> str:
         """Save state (+ metrics/meta JSON) at ``step`` (default: state.step).
 
@@ -363,31 +450,69 @@ class Checkpointer:
         meta JSON carries a topology manifest derived from the live
         leaves' shardings (``plan=`` additionally stamps the
         ``ParallelPlan`` signature), which is what makes the step
-        restorable onto a different mesh shape (:meth:`restore`).
+        restorable onto a different mesh shape (:meth:`restore`), and —
+        when the Trainer's health sentinel is on — a ``health`` stamp
+        (loss EWMA, grad norm, bad-step count, ``healthy`` verdict),
+        which is what divergence rollback
+        (:func:`rollback_to_last_healthy`) selects on.
+
+        Transient-IO retry: OSError-class failures of the write are
+        retried ``TPUFRAME_CKPT_SAVE_RETRIES`` times (default 2) with
+        the supervisor's full-jitter backoff — a storage flake should
+        cost a ``ckpt/save_retries`` tick, not a whole restart-budget
+        slot.  Synchronous saves only: with ``async_save=True`` an
+        OSError surfacing later in ``wait()`` is past this window.
         """
         if step is None:
             step = int(jax.device_get(_state_data(state).get("step", 0) or 0))
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         meta = dict(meta or {})
         manifest = topology_manifest(state, plan)
+        retries = _env_int("TPUFRAME_CKPT_SAVE_RETRIES", 2)
         # span + watchdog lease: a checkpoint write wedging on a dead
         # filesystem or a stuck collective is one of the documented silent
         # hangs — under a watchdog it becomes an attributed stall report
         tele = get_telemetry()
         with tele.span("ckpt/save", step=int(step)), tele.guard("ckpt/save"):
-            chaos.maybe_fire("ckpt/save", step=int(step),
-                             directory=self.directory)
-            self._mgr.save(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardSave(_state_data(state)),
-                    meta=ocp.args.JsonSave(
-                        {"meta": meta, "metrics": metrics, "topology": manifest}
-                    ),
-                ),
-                metrics=metrics or None,
-                force=force,
-            )
+            for attempt in range(retries + 1):
+                try:
+                    # the chaos site sits INSIDE the retry window: an
+                    # injected ChaosError (an OSError) is exactly the
+                    # storage flake the retry exists to absorb
+                    chaos.maybe_fire("ckpt/save", step=int(step),
+                                     directory=self.directory)
+                    self._mgr.save(
+                        step,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardSave(_state_data(state)),
+                            meta=ocp.args.JsonSave(
+                                {"meta": meta, "metrics": metrics,
+                                 "topology": manifest,
+                                 "health": dict(health) if health else None}
+                            ),
+                        ),
+                        metrics=metrics or None,
+                        # a retry may land on a partially-written step
+                        # dir from the failed attempt: overwrite it
+                        force=force or attempt > 0,
+                    )
+                    break
+                except OSError as e:
+                    if attempt >= retries:
+                        raise
+                    from tpuframe.fault.supervisor import backoff_delay
+
+                    delay = backoff_delay(attempt + 1, base_s=0.25, max_s=4.0)
+                    tele.registry.counter("ckpt/save_retries").inc()
+                    tele.event(
+                        "ckpt/save_retry",
+                        step=int(step),
+                        attempt=attempt + 1,
+                        retries=retries,
+                        delay_s=round(delay, 3),
+                        error=repr(e)[:300],
+                    )
+                    time.sleep(delay)
         path = os.path.join(self.directory, str(step))
         # post-write injection point: TornCheckpoint tears the commit
         # marker here, reproducing a kill between data write and commit
@@ -397,7 +522,8 @@ class Checkpointer:
 
     # -- restore -----------------------------------------------------------
     def restore(
-        self, state: Any, step: int | None = None, *, plan: Any = None
+        self, state: Any, step: int | None = None, *, plan: Any = None,
+        healthy_only: bool = False,
     ) -> tuple[Any, dict]:
         """Restore ``step`` (default latest) into the template ``state``.
 
@@ -420,10 +546,19 @@ class Checkpointer:
         """
         if step is None:
             # newest *committed* step: orbax's own latest_step() counts
-            # torn digit-dirs, and restoring one fails mid-read
-            step = self.latest_step()
+            # torn digit-dirs, and restoring one fails mid-read.  With
+            # ``healthy_only`` the newest committed step whose health
+            # stamp says healthy — the divergence-recovery ask (absent
+            # stamps count healthy, so pre-sentinel history qualifies)
+            step = (
+                self.latest_healthy_step() if healthy_only
+                else self.latest_step()
+            )
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            raise FileNotFoundError(
+                f"no {'healthy ' if healthy_only else ''}checkpoints "
+                f"under {self.directory}"
+            )
         template = _state_data(state)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         if plan is not None:
@@ -508,10 +643,20 @@ class Checkpointer:
         except (FileNotFoundError, KeyError):
             pass  # already gone / never existed
 
+    def latest_healthy_step(self) -> int | None:
+        """Newest committed step whose health stamp is absent-or-healthy
+        (the divergence-rollback target)."""
+        return latest_healthy_step(self.directory)
+
     def manifest_for(self, step: int | None = None) -> dict | None:
         """The topology manifest bundled with ``step`` (default latest
         committed); None for pre-manifest or manifest-free checkpoints."""
         return read_manifest(self.directory, step)
+
+    def health_for(self, step: int | None = None) -> dict | None:
+        """The health stamp bundled with ``step`` (default latest
+        committed); None for pre-sentinel checkpoints."""
+        return read_health(self.directory, step)
 
     def metrics_for(self, step: int) -> dict:
         """The metrics JSON bundled with ``step`` (Ray-style result reload)."""

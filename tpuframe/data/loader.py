@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import multiprocessing
+import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -164,6 +165,28 @@ class BatchBufferPool:
                 return True
         return False
 
+#: Sample-fetch failures that read as a BAD RECORD rather than a bug:
+#: decode errors (the strict native JPEG path and PIL both raise
+#: ValueError/OSError on corrupt entropy data), shard I/O, codec
+#: failures.  Bugs (TypeError, AttributeError, IndexError from a
+#: mis-sized sampler) still raise immediately — the quarantine is for
+#: poisoned *data*, not broken *code*.
+_SKIPPABLE_SAMPLE_ERRORS = (ValueError, OSError, RuntimeError)
+
+
+class _BadSample:
+    """What a fetch returns instead of raising for a corrupt sample.
+
+    A sentinel (not an exception) so it crosses the process-pool
+    boundary as an ordinary pickled result: workers cannot emit the
+    parent's telemetry, so the *parent* counts, logs and enforces the
+    ``TPUFRAME_MAX_BAD_SAMPLES`` cap."""
+
+    def __init__(self, index: int, error: str):
+        self.index = index
+        self.error = error
+
+
 # Process-pool workers inherit the dataset via fork (copy-on-write — no
 # per-item pickling of the dataset, only of the returned samples).  A
 # module global is the one channel fork-inherited state can ride.
@@ -189,7 +212,13 @@ def _pool_get(args):
         if hasattr(_WORKER_DATASET, "set_epoch"):
             _WORKER_DATASET.set_epoch(epoch)
         _WORKER_EPOCH = epoch
-    return _WORKER_DATASET[int(idx)]
+    try:
+        return _WORKER_DATASET[int(idx)]
+    except _SKIPPABLE_SAMPLE_ERRORS as e:
+        # bad-record quarantine: return the sentinel (picklable) so the
+        # parent can skip-and-count instead of the whole epoch dying on
+        # one corrupt JPEG
+        return _BadSample(int(idx), f"{type(e).__name__}: {e}")
 
 
 class DataLoader:
@@ -399,6 +428,15 @@ class DataLoader:
         self._resume_offset = offset
         self._pos = (int(state["epoch"]), offset)
 
+    def _fetch_one(self, idx: int):
+        """One sample, with decode/IO failures downgraded to a
+        :class:`_BadSample` sentinel (thread/inline path; the process
+        pool does the same inside ``_pool_get``)."""
+        try:
+            return self.dataset[idx]
+        except _SKIPPABLE_SAMPLE_ERRORS as e:
+            return _BadSample(idx, f"{type(e).__name__}: {e}")
+
     def release_oldest(self, device_arrays=None) -> bool:
         """Recycle the oldest outstanding batch's ring buffers (FIFO).
 
@@ -521,12 +559,12 @@ class DataLoader:
         elif self.num_workers:
             pool = ThreadPoolExecutor(self.num_workers)
             fetch = lambda idxs: list(  # noqa: E731
-                pool.map(lambda i: self.dataset[int(i)], idxs)
+                pool.map(lambda i: self._fetch_one(int(i)), idxs)
             )
         else:
             # plain Python ints: torch-style datasets (the reference's
             # map-style Dataset contract) often reject numpy indices
-            fetch = lambda idxs: [self.dataset[int(i)] for i in idxs]  # noqa: E731
+            fetch = lambda idxs: [self._fetch_one(int(i)) for i in idxs]  # noqa: E731
         # mid-epoch resume: skip already-consumed batches arithmetically
         # (the permutation is (seed, epoch)-deterministic, so no fetch of
         # skipped samples is needed); a fresh epoch starts at 0
@@ -534,6 +572,57 @@ class DataLoader:
         self._resume_offset = 0
         self._pos = (epoch, start)
         tele = get_telemetry()
+
+        # bad-sample quarantine: corrupt records are skipped-and-counted
+        # (one `data/bad_sample` event each) up to a per-epoch cap —
+        # one poisoned shard degrades the epoch instead of killing it,
+        # while a systematically broken dataset still raises fast
+        from tpuframe.fault.health import _env_int
+
+        max_bad = _env_int("TPUFRAME_MAX_BAD_SAMPLES", 8)
+        bad_count = 0
+
+        def screen(items: list, gen_rows, batch_idx: int) -> tuple:
+            """Drop :class:`_BadSample` sentinels (and their genuine
+            flags), enforcing the cap; ``assemble``'s tail-pad refills
+            the shortened batch by cycling the surviving good samples.
+            On the eval path (``drop_last=False``) the pad rows carry a
+            ``valid=False`` mask; on the train path they are UNMASKED
+            repeats — bounded by the cap (a handful of duplicated
+            samples per epoch), because growing a weight column
+            mid-epoch would change the pinned train batch signature."""
+            nonlocal bad_count
+            bad = [it for it in items if isinstance(it, _BadSample)]
+            if not bad:
+                return items, gen_rows
+            for b in bad:
+                bad_count += 1
+                tele.registry.counter("data/bad_samples").inc()
+                tele.event(
+                    "data/bad_sample",
+                    index=b.index, error=b.error[:300], batch=batch_idx,
+                )
+            if bad_count > max_bad:
+                raise RuntimeError(
+                    f"{bad_count} bad sample(s) this epoch exceed "
+                    f"TPUFRAME_MAX_BAD_SAMPLES={max_bad}; the dataset is "
+                    f"poisoned beyond skip-and-count (last: sample "
+                    f"{bad[-1].index}: {bad[-1].error})"
+                )
+            good = [
+                (it, bool(g))
+                for it, g in zip(items, gen_rows)
+                if not isinstance(it, _BadSample)
+            ]
+            if not good:
+                raise RuntimeError(
+                    f"every sample in batch {batch_idx} was bad "
+                    f"(last: sample {bad[-1].index}: {bad[-1].error}); "
+                    "nothing left to assemble"
+                )
+            return [it for it, _ in good], np.asarray(
+                [g for _, g in good], bool
+            )
 
         def assemble(items, gen_rows) -> tuple:
             """Write fetched samples into a leased ring buffer — the
@@ -551,8 +640,13 @@ class DataLoader:
                 np.copyto(lease.images[i], im, casting="same_kind")
                 lease.labels[i] = lb
             for i in range(n, self.local_batch_size):  # ragged-tail pad
-                np.copyto(lease.images[i], items[-1][0], casting="same_kind")
-                lease.labels[i] = items[-1][1]
+                # cycle over the good samples: under drop_last the pad is
+                # UNMASKED (adding a weight column mid-epoch would change
+                # the train batch signature the compile spine pinned), so
+                # spreading beats weighting one sample k+1 times
+                src = items[i % n]
+                np.copyto(lease.images[i], src[0], casting="same_kind")
+                lease.labels[i] = src[1]
             if lease.valid is None:
                 out = (lease.images, lease.labels)
             else:
@@ -570,7 +664,7 @@ class DataLoader:
             for b in range(start, nb_full):
                 sl = slice(b * self.local_batch_size, (b + 1) * self.local_batch_size)
                 with tele.span("data/assemble", batch=b):
-                    out = assemble(fetch(indices[sl]), genuine[sl])
+                    out = assemble(*screen(fetch(indices[sl]), genuine[sl], b))
                 # count BEFORE the yield: a generator suspends AT the
                 # yield, so a post-yield update would lag one batch behind
                 # what the caller has already consumed
@@ -579,7 +673,9 @@ class DataLoader:
             if tail and not self.drop_last and start <= nb_full:
                 sl = slice(nb_full * self.local_batch_size, None)
                 with tele.span("data/assemble", batch=nb_full):
-                    out = assemble(fetch(indices[sl]), genuine[sl])
+                    out = assemble(
+                        *screen(fetch(indices[sl]), genuine[sl], nb_full)
+                    )
                 self._pos = (epoch, nb_full + 1)
                 yield out
         finally:

@@ -198,6 +198,61 @@ def ckpt_section(directory: str | None = None,
     return out
 
 
+def health_section(directory: str | None = None) -> dict:
+    """State of the training-health sentinel (``tpuframe.fault.health``):
+    whether it is on, the live thresholds (env overrides applied), the
+    ``TPUFRAME_HEALTH_*`` env, and — when a checkpoint directory is
+    known — the newest committed step's health stamp plus the rollback
+    target, so a "my run diverged" report says up front what the
+    sentinel would do about it.  Stdlib-only reads, like
+    ``read_manifest``."""
+    import dataclasses
+
+    from tpuframe.fault.health import (
+        HEALTH_ENV_VARS,
+        HealthPolicy,
+        enabled_by_env,
+    )
+
+    # malformed env (TPUFRAME_HEALTH_WINDOW=0, ...) must not crash the
+    # report that exists to surface it: show the error WITH the env
+    try:
+        thresholds = dataclasses.asdict(HealthPolicy.from_env())
+    except ValueError as e:
+        thresholds = {"error": str(e)}
+    out: dict = {
+        "enabled": enabled_by_env(),
+        "thresholds": thresholds,
+        "env": {
+            k: os.environ[k] for k in HEALTH_ENV_VARS if k in os.environ
+        },
+    }
+    directory = directory or os.environ.get("TPUFRAME_CKPT_DIR")
+    if directory:
+        from tpuframe.ckpt.checkpoint import (
+            latest_healthy_step,
+            latest_step,
+            read_health,
+        )
+
+        latest = latest_step(directory)
+        healthy = latest_healthy_step(directory)
+        out["latest_checkpoint"] = {
+            "step": latest,
+            "health": read_health(directory, latest) if latest is not None
+            else None,
+            "latest_healthy_step": healthy,
+        }
+        if latest is not None and healthy != latest:
+            out["latest_checkpoint"]["warning"] = (
+                f"newest committed step {latest} is stamped unhealthy; a "
+                f"divergence rollback would resume at {healthy} "
+                "(fault.Supervisor does this automatically; by hand: "
+                "tpuframe.ckpt.rollback_to_last_healthy(dir))"
+            )
+    return out
+
+
 def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None) -> dict:
     """Collect the full environment report (pure data; printing is main's)."""
     import tpuframe
@@ -240,6 +295,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None) -> dict:
         # jax.config, so the env var being unset says nothing
         "compile": compile_section(),
         "ckpt": ckpt_section(ckpt_dir, devices.get("device_count")),
+        "health": health_section(ckpt_dir),
         "env": {
             k: os.environ[k]
             for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",

@@ -8,10 +8,15 @@ natively on tpuframe's Checkpointer + telemetry spine:
   last-chance checkpoints, multi-host agreement, :class:`Preempted` status
 - ``fault.chaos``      — deterministic seeded fault injection at named
   call sites (loader raise, step stall, torn checkpoint, worker kill,
-  preemption notice) — recovery is *tested*, not assumed
+  preemption notice, NaN/spike batch poison) — recovery is *tested*,
+  not assumed
 - ``fault.supervisor`` — restart orchestration: per-failure-class budgets,
   exponential backoff with full jitter, pre-resume quarantine of torn
-  checkpoint steps
+  checkpoint steps, divergence rollback to the last healthy checkpoint
+- ``fault.health``     — training-health sentinel: on-device non-finite/
+  loss-spike detection fused into the jitted step, branch-free bad-step
+  skip, and the :class:`Divergence` escalation the supervisor answers
+  with rollback + perturbed re-entry
 
 Failure-mode catalog, injector reference and recovery runbook: FAULT.md.
 Like the telemetry spine it reports through, everything here except the
@@ -24,13 +29,22 @@ from tpuframe.fault.chaos import (
     Injector,
     KillWorker,
     LoseRank,
+    NaNAt,
     PreemptNotice,
     RaiseAt,
     RankLostError,
+    SpikeAt,
     StallAt,
     TornCheckpoint,
     lost_ranks,
     reset_lost_ranks,
+)
+from tpuframe.fault.health import (
+    Divergence,
+    HEALTH_ENV_VARS,
+    HealthPolicy,
+    recovery_directive,
+    reset_recovery,
 )
 from tpuframe.fault.preempt import (
     PREEMPTED_EXIT,
@@ -52,10 +66,14 @@ from tpuframe.fault.supervisor import (
 __all__ = [
     "ChaosError",
     "ChaosPlan",
+    "Divergence",
     "FailureClass",
+    "HEALTH_ENV_VARS",
+    "HealthPolicy",
     "Injector",
     "KillWorker",
     "LoseRank",
+    "NaNAt",
     "PREEMPTED_EXIT",
     "Preempted",
     "PreemptNotice",
@@ -63,6 +81,7 @@ __all__ = [
     "RaiseAt",
     "RankLostError",
     "RestartPolicy",
+    "SpikeAt",
     "StallAt",
     "Supervisor",
     "TornCheckpoint",
@@ -72,6 +91,8 @@ __all__ = [
     "gce_maintenance_poller",
     "lost_ranks",
     "preemption_requested",
+    "recovery_directive",
     "reset_lost_ranks",
+    "reset_recovery",
     "run_supervised",
 ]

@@ -16,8 +16,12 @@ read):
 site               where
 =================  =========================================================
 ``loader``         Trainer._run_epoch, before pulling the next host batch
+``batch``          Trainer host pipeline, on the assembled numpy train
+                   batch (ctx: ``images``) — where :class:`NaNAt` /
+                   :class:`SpikeAt` poison the data the jitted step eats
 ``step``           Trainer._run_epoch, before dispatching the train step
-``ckpt/save``      Checkpointer.save, before the orbax write
+``ckpt/save``      Checkpointer.save, before the orbax write (inside the
+                   transient-IO retry window)
 ``ckpt/saved``     Checkpointer.save, after the write (ctx: ``path``) —
                    where :class:`TornCheckpoint` tears the commit marker
 =================  =========================================================
@@ -49,9 +53,11 @@ __all__ = [
     "Injector",
     "KillWorker",
     "LoseRank",
+    "NaNAt",
     "PreemptNotice",
     "RaiseAt",
     "RankLostError",
+    "SpikeAt",
     "StallAt",
     "TornCheckpoint",
     "active_plan",
@@ -226,6 +232,83 @@ class LoseRank(Injector):
     def describe(self) -> str:
         return (f"LoseRank(ranks={sorted(self.ranks)}, site={self.site!r}, "
                 f"step={self.step})")
+
+
+class _BatchPoison(Injector):
+    """Shared base of the health-sentinel injectors (:class:`NaNAt`,
+    :class:`SpikeAt`): fire at the ``batch`` site and corrupt the HOST
+    numpy batch in place — upstream of the device copy, so the jitted
+    step's on-device health check sees the poison exactly as it would a
+    corrupt record or a broken augmentation.
+
+    **Poison window**: unlike the other injectors (``times`` counts
+    visits at one step), an explicit ``step`` with ``times=n`` poisons
+    the *n consecutive* batches ``[step, step+n)`` — the shape a real
+    divergence has, and what drives the skip -> Divergence escalation
+    (``max_bad`` bad steps inside a window) deterministically.
+    """
+
+    def matches(self, site: str, step: int | None) -> bool:
+        if self.fired >= self.times or site != self.site:
+            return False
+        if self.step is None:
+            return True
+        return step is not None and self.step <= step < self.step + self.times
+
+    def _images(self, ctx: Mapping[str, Any]):
+        images = ctx.get("images")
+        # ValueError: a misconfigured drill is a FATAL-class error — the
+        # supervisor must surface it immediately, not burn restart
+        # budget retrying a configuration mistake
+        if images is None:
+            raise ValueError(
+                f"{type(self).__name__} fired at site {self.site!r} which "
+                "carries no host image batch — schedule it at the 'batch' "
+                "site"
+            )
+        if getattr(images.dtype, "kind", None) != "f":
+            raise ValueError(
+                f"{type(self).__name__} cannot poison a "
+                f"{images.dtype} batch (uint8 transfer can't represent "
+                "the poison) — use a float transfer_dtype for this chaos "
+                "run instead of letting the test pass vacuously"
+            )
+        return images
+
+    def describe(self) -> str:
+        span = (f"steps [{self.step}, {self.step + self.times})"
+                if self.step is not None else f"first {self.times} visit(s)")
+        return f"{type(self).__name__}(site={self.site!r}, {span})"
+
+
+class NaNAt(_BatchPoison):
+    """Write NaN into the host batch — the jitted step's loss/grads go
+    non-finite and the sentinel must skip the update (then escalate to
+    :class:`~tpuframe.fault.health.Divergence` when the poison window
+    outlasts ``max_bad``).  One poisoned sample is enough: the loss mean
+    propagates it."""
+
+    def __init__(self, site: str = "batch", step: int | None = None, *,
+                 times: int = 1):
+        super().__init__(site, step, times=times)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        self._images(ctx)[0].fill(float("nan"))
+
+
+class SpikeAt(_BatchPoison):
+    """Scale the host batch by ``scale`` — a finite but blown-up loss,
+    the EWMA spike detector's target (non-finiteness checks never see
+    it)."""
+
+    def __init__(self, site: str = "batch", step: int | None = None, *,
+                 scale: float = 1e4, times: int = 1):
+        super().__init__(site, step, times=times)
+        self.scale = float(scale)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        images = self._images(ctx)
+        images *= self.scale
 
 
 class PreemptNotice(Injector):

@@ -1,0 +1,417 @@
+"""Training-health sentinel: non-finite/spike detection, skip-step, rollback.
+
+The fault spine survives process death (supervisor), topology loss
+(elastic recovery) and torn checkpoints (quarantine) — this module
+handles the most common large-run killer left: the *run itself* going
+bad.  One poisoned batch or numerical blow-up produces a NaN/Inf loss or
+gradient; without a sentinel that single step silently contaminates the
+optimizer state and every checkpoint after it, and the supervisor
+faithfully restarts into the same divergence.
+
+The ladder (PaLM-style spike handling, TorchTitan's "recoverable
+training is a production requirement"):
+
+1. **Detect, on device.**  The jitted train step computes the global
+   gradient norm and the finiteness of loss/grads as ONE fused reduction
+   (``tpuframe.train.step`` calls :func:`health_verdict`), plus an EWMA
+   loss-spike check against device-carried state
+   (``TrainState.health``).  No extra host sync: the verdict rides the
+   step's existing metrics pytree and the Trainer reads it at a fixed
+   window cadence.
+2. **Skip-step.**  A non-finite or spiking step applies NO update —
+   ``jnp.where`` on the verdict selects the old params/opt_state/
+   batch_stats, so the compiled program is branch-free and the AOT
+   signatures from the compile spine are untouched.  The Trainer emits
+   ``health/bad_step`` + counters at the window check.
+3. **Divergence.**  ``max_bad`` bad steps inside a ``window`` raises
+   :class:`Divergence` — a dedicated supervisor failure class with its
+   own restart budget.  The supervisor **rolls back to the last
+   checkpoint whose health stamp says healthy**
+   (``ckpt.checkpoint.rollback_to_last_healthy``; every save stamps
+   loss-EWMA/grad-norm/bad-step state next to the topology manifest)
+   and re-enters with a perturbation — LR backoff and/or a data-order
+   skip past the poison window — so a deterministic replay does not
+   re-hit the same spike.
+
+Everything env-tunable ships to workers via :data:`HEALTH_ENV_VARS`
+(``launch.remote``) and prints in the doctor's ``health`` section.
+Module import is stdlib-only (jax is imported lazily inside the
+device-side helpers), so the supervisor keeps working while jax is
+wedged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Any, Mapping
+
+__all__ = [
+    "Divergence",
+    "HEALTH_ENV_VARS",
+    "HEALTH_STATS_FIELDS",
+    "HealthPolicy",
+    "RecoveryDirective",
+    "consume_skip_batches",
+    "escalate_recovery",
+    "health_verdict",
+    "init_health_state",
+    "recovery_directive",
+    "reset_recovery",
+    "resolve_policy",
+    "unpack_health_stats",
+]
+
+#: every env knob the health sentinel (and its satellites) reads — THE
+#: list, shipped to every worker by ``launch.remote._worker_env`` and
+#: printed by the doctor's ``health`` section.  Add knobs here, not in
+#: the consumers.
+HEALTH_ENV_VARS = (
+    "TPUFRAME_HEALTH",
+    "TPUFRAME_HEALTH_SPIKE_FACTOR",
+    "TPUFRAME_HEALTH_SPIKE_MARGIN",
+    "TPUFRAME_HEALTH_EWMA_DECAY",
+    "TPUFRAME_HEALTH_WARMUP_STEPS",
+    "TPUFRAME_HEALTH_WINDOW",
+    "TPUFRAME_HEALTH_MAX_BAD",
+    "TPUFRAME_HEALTH_LR_BACKOFF",
+    "TPUFRAME_HEALTH_SKIP_BATCHES",
+    "TPUFRAME_MAX_BAD_SAMPLES",
+    "TPUFRAME_CKPT_SAVE_RETRIES",
+)
+
+_FALSY = ("0", "false", "no", "off", "disabled")
+
+
+class Divergence(RuntimeError):
+    """Training diverged: ``bad_in_window`` skipped steps inside the
+    health window — skip-step alone is no longer converging.  Its own
+    supervisor failure class (DIVERGENCE, ``max_divergences`` budget):
+    the restart rolls back to the last *healthy* committed checkpoint
+    and re-enters with the configured perturbation, instead of
+    resuming the newest (possibly poisoned) step at equal hyperparams.
+    """
+
+    def __init__(self, msg: str, *, step: int | None = None,
+                 bad_in_window: int | None = None, window: int | None = None,
+                 loss_ewma: float | None = None,
+                 policy: "HealthPolicy | None" = None):
+        super().__init__(msg)
+        self.step = step
+        self.bad_in_window = bad_in_window
+        self.window = window
+        self.loss_ewma = loss_ewma
+        # the raising Trainer's policy rides to the supervisor, so a
+        # PROGRAMMATIC HealthPolicy(lr_backoff=..., skip_batches=...)
+        # shapes the recovery exactly like the env knobs would
+        self.policy = policy
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env_float(name, float(default)))
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthPolicy:
+    """Sentinel thresholds + escalation shape.
+
+    Attributes:
+      spike_factor / spike_margin: a (finite) loss is a spike when
+        ``loss > ewma * spike_factor + spike_margin`` — relative to the
+        device-carried loss EWMA, once warmed.  The margin's non-zero
+        default floors the test: near convergence (EWMA ~1e-4) routine
+        batch-to-batch ratios exceed any factor, and a purely relative
+        test would rollback a healthy run; a blown-up batch clears the
+        margin regardless of scale.
+      ewma_decay: EWMA decay per *good* step (bad steps never update the
+        EWMA — a spike must not poison its own baseline).
+      warmup_steps: spike checks arm only after this many good steps
+        (the EWMA is meaningless over the first noisy steps; non-finite
+        detection is always armed).
+      window / max_bad: the escalation ladder — ``max_bad`` bad steps
+        inside a ``window``-step check window raises :class:`Divergence`.
+        The window is also the host fetch cadence of the verdict (one
+        tiny device read per window, not per step).
+      lr_backoff: multiplied into the LR schedule per divergence
+        recovery (0.5 = halve on each re-entry); 1.0 disables.
+      skip_batches: data-order skip applied after the rollback restore —
+        re-enter past the poison window instead of replaying it.
+    """
+
+    spike_factor: float = 4.0
+    spike_margin: float = 0.05
+    ewma_decay: float = 0.98
+    warmup_steps: int = 20
+    window: int = 16
+    max_bad: int = 4
+    lr_backoff: float = 0.5
+    skip_batches: int = 0
+
+    def __post_init__(self):
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.max_bad < 1:
+            raise ValueError(f"max_bad must be >= 1, got {self.max_bad}")
+        if not 0.0 < self.ewma_decay < 1.0:
+            raise ValueError(
+                f"ewma_decay must be in (0, 1), got {self.ewma_decay}"
+            )
+
+    @classmethod
+    def from_env(cls) -> "HealthPolicy":
+        """Defaults overridden by the ``TPUFRAME_HEALTH_*`` knobs."""
+        return cls(
+            spike_factor=_env_float("TPUFRAME_HEALTH_SPIKE_FACTOR", 4.0),
+            spike_margin=_env_float("TPUFRAME_HEALTH_SPIKE_MARGIN", 0.05),
+            ewma_decay=_env_float("TPUFRAME_HEALTH_EWMA_DECAY", 0.98),
+            warmup_steps=_env_int("TPUFRAME_HEALTH_WARMUP_STEPS", 20),
+            window=_env_int("TPUFRAME_HEALTH_WINDOW", 16),
+            max_bad=_env_int("TPUFRAME_HEALTH_MAX_BAD", 4),
+            lr_backoff=_env_float("TPUFRAME_HEALTH_LR_BACKOFF", 0.5),
+            skip_batches=_env_int("TPUFRAME_HEALTH_SKIP_BATCHES", 0),
+        )
+
+
+def enabled_by_env() -> bool:
+    """The sentinel default: on unless ``TPUFRAME_HEALTH`` is falsy."""
+    v = os.environ.get("TPUFRAME_HEALTH", "").strip().lower()
+    return not v or v not in _FALSY
+
+
+def resolve_policy(health: Any) -> HealthPolicy | None:
+    """Trainer-facing resolution: ``None`` follows ``TPUFRAME_HEALTH``
+    (default on), ``True`` forces env defaults, ``False`` disables, a
+    :class:`HealthPolicy` is used as-is."""
+    if health is False:
+        return None
+    if isinstance(health, HealthPolicy):
+        return health
+    if health is True:
+        return HealthPolicy.from_env()
+    if health is None:
+        return HealthPolicy.from_env() if enabled_by_env() else None
+    raise ValueError(
+        "health must be None (follow TPUFRAME_HEALTH), True, False, or a "
+        f"HealthPolicy; got {type(health).__name__}"
+    )
+
+
+# -- device-side state + verdict (jax imported lazily) ------------------------
+
+#: field order of the packed ``health_stats`` metrics vector
+HEALTH_STATS_FIELDS = (
+    "health_bad",
+    "health_nonfinite",
+    "health_spike",
+    "grad_norm_sum",
+    "health_steps",
+)
+
+
+def unpack_health_stats(vec) -> dict:
+    """Split a (summed) ``health_stats`` vector into the named scalar
+    floats, :data:`HEALTH_STATS_FIELDS` order."""
+    vals = [float(v) for v in vec]
+    return dict(zip(HEALTH_STATS_FIELDS, vals))
+
+
+def init_health_state() -> dict:
+    """The device-carried sentinel state, a plain-dict pytree of f32
+    scalars (no new dependency in the TrainState schema; NOT serialized
+    into checkpoints — a restore deliberately restarts the EWMA warmup
+    on fresh ground):
+
+    - ``loss_ewma`` / ``good_steps``: the spike baseline and its warmup
+      counter (good steps only).
+    - ``bad_steps``: cumulative skipped steps (the checkpoint stamp).
+    - ``last_bad_step``: optimizer step of the newest skip (-1 = never);
+      a save is stamped *healthy* when the last bad step is outside the
+      check window.
+    - ``grad_norm``: the last computed global grad norm (raw — may be
+      inf/nan on a bad step; hosts sanitize before JSON).
+    """
+    import jax.numpy as jnp
+
+    # one array PER field: the train step donates its state, and a
+    # shared zeros buffer would be donated N times in one Execute()
+    return {
+        "loss_ewma": jnp.zeros((), jnp.float32),
+        "good_steps": jnp.zeros((), jnp.float32),
+        "bad_steps": jnp.zeros((), jnp.float32),
+        "last_bad_step": jnp.full((), -1.0, jnp.float32),
+        "grad_norm": jnp.zeros((), jnp.float32),
+    }
+
+
+def health_verdict(loss, grads, hstate: Mapping[str, Any], step,
+                   policy: HealthPolicy):
+    """The traced per-step check: ONE fused reduction over the gradient
+    pytree (sum of squares — non-finite anywhere surfaces as a
+    non-finite total), loss finiteness, and the EWMA spike test.
+
+    Returns ``(bad, new_hstate, health_metrics)`` where ``bad`` is a
+    scalar bool (the skip verdict), ``new_hstate`` the updated sentinel
+    state (EWMA advanced on good steps only), and ``health_metrics`` a
+    single summed-convention ``health_stats`` vector riding the step's
+    metrics pytree — :data:`HEALTH_STATS_FIELDS` in order
+    (``health_bad``/``health_nonfinite``/``health_spike`` flags,
+    ``grad_norm_sum`` over finite steps, ``health_steps``), packed as
+    ONE leaf so the Trainer's per-step metrics-window accumulation
+    dispatches one add for the sentinel, not five
+    (:func:`unpack_health_stats` splits it host-side).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    loss = jnp.asarray(loss, jnp.float32)
+    grad_sq = sum(
+        jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for g in jax.tree.leaves(grads)
+    )
+    grad_norm = jnp.sqrt(grad_sq)
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_sq)
+    warmed = hstate["good_steps"] >= policy.warmup_steps
+    spike = (
+        finite
+        & warmed
+        & (loss > hstate["loss_ewma"] * policy.spike_factor
+           + policy.spike_margin)
+    )
+    bad = (~finite) | spike
+    good = ~bad
+    d = jnp.float32(policy.ewma_decay)
+    # seed with the first good loss; a bad step never moves the baseline
+    seeded = jnp.where(hstate["good_steps"] > 0, hstate["loss_ewma"], loss)
+    new_ewma = jnp.where(good, d * seeded + (1.0 - d) * loss,
+                         hstate["loss_ewma"])
+    f32 = jnp.float32
+    new_hstate = {
+        "loss_ewma": new_ewma,
+        "good_steps": hstate["good_steps"] + good.astype(f32),
+        "bad_steps": hstate["bad_steps"] + bad.astype(f32),
+        "last_bad_step": jnp.where(
+            bad, jnp.asarray(step, f32), hstate["last_bad_step"]
+        ),
+        "grad_norm": grad_norm,
+    }
+    metrics = {
+        "health_stats": jnp.stack([
+            bad.astype(f32),
+            (~finite).astype(f32),
+            spike.astype(f32),
+            jnp.where(finite, grad_norm, f32(0.0)),
+            f32(1.0),
+        ]),
+    }
+    return bad, new_hstate, metrics
+
+
+def health_stamp(hstate: Mapping[str, Any], step: int,
+                 policy: HealthPolicy) -> dict:
+    """The JSON health record :meth:`Checkpointer.save` embeds next to
+    the topology manifest — read back (stdlib-only,
+    ``ckpt.checkpoint.read_health``) by rollback and the doctor.
+    ``healthy`` means the newest bad step is at least one full check
+    window behind this save (or there never was one)."""
+    def _f(v) -> float | None:
+        v = float(v)
+        return v if math.isfinite(v) else None
+
+    last_bad = float(hstate["last_bad_step"])
+    healthy = last_bad < 0 or (step - last_bad) > policy.window
+    return {
+        "healthy": bool(healthy),
+        "step": int(step),
+        "loss_ewma": _f(hstate["loss_ewma"]),
+        "grad_norm": _f(hstate["grad_norm"]),
+        "bad_steps": int(float(hstate["bad_steps"])),
+        "last_bad_step": int(last_bad),
+        "window": policy.window,
+    }
+
+
+# -- divergence recovery directive (process-wide) -----------------------------
+
+
+@dataclasses.dataclass
+class RecoveryDirective:
+    """What the next supervised attempt applies after a divergence
+    rollback: ``lr_scale`` multiplies the LR schedule (compounds per
+    divergence: ``lr_backoff ** n``), ``skip_batches`` advances the
+    restored loader position past the poison window, ``divergences``
+    counts escalations since :func:`reset_recovery`."""
+
+    lr_scale: float = 1.0
+    skip_batches: int = 0
+    divergences: int = 0
+
+
+_DIRECTIVE = RecoveryDirective()
+_DIRECTIVE_LOCK = threading.Lock()
+
+
+def recovery_directive() -> RecoveryDirective:
+    """The current directive (a copy; mutate via :func:`escalate_recovery`)."""
+    with _DIRECTIVE_LOCK:
+        return dataclasses.replace(_DIRECTIVE)
+
+
+def reset_recovery() -> None:
+    """Clear the directive (the supervisor does this when a run starts,
+    so one run's escalations never leak into the next)."""
+    global _DIRECTIVE
+    with _DIRECTIVE_LOCK:
+        _DIRECTIVE = RecoveryDirective()
+
+
+def consume_skip_batches() -> int:
+    """One-shot read of the directive's data-order skip, cleared on a
+    non-zero read: only the FIRST fit after a rollback skips past the
+    poison window.  A later unrelated restart (transient IO, preemption)
+    restores well past the window already — re-skipping there would
+    silently drop healthy batches on every attempt.  ``lr_scale`` is
+    deliberately NOT one-shot: the backoff applies for the rest of the
+    run (until :func:`reset_recovery`)."""
+    global _DIRECTIVE
+    with _DIRECTIVE_LOCK:
+        n = _DIRECTIVE.skip_batches
+        if n:
+            _DIRECTIVE = dataclasses.replace(_DIRECTIVE, skip_batches=0)
+        return n
+
+
+def escalate_recovery(policy: HealthPolicy | None = None) -> RecoveryDirective:
+    """One divergence happened: compound the LR backoff and (re)arm the
+    data-order skip per ``policy`` (default: env knobs).  Called by the
+    supervisor before the rollback restart; the next Trainer
+    construction consumes the result."""
+    policy = policy or HealthPolicy.from_env()
+    global _DIRECTIVE
+    with _DIRECTIVE_LOCK:
+        _DIRECTIVE = RecoveryDirective(
+            lr_scale=_DIRECTIVE.lr_scale * policy.lr_backoff,
+            skip_batches=policy.skip_batches,
+            divergences=_DIRECTIVE.divergences + 1,
+        )
+        out = dataclasses.replace(_DIRECTIVE)
+    from tpuframe.track.telemetry import get_telemetry
+
+    get_telemetry().event(
+        "health/recovery_directive",
+        lr_scale=round(out.lr_scale, 6),
+        skip_batches=out.skip_batches,
+        divergences=out.divergences,
+    )
+    return out

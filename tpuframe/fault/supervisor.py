@@ -44,6 +44,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from tpuframe.fault import health as _health
+from tpuframe.fault.health import Divergence
 from tpuframe.fault.preempt import Preempted
 from tpuframe.track.telemetry import get_telemetry
 
@@ -69,6 +71,10 @@ class WorldTooSmall(RuntimeError):
 class FailureClass(enum.Enum):
     #: the platform reclaimed the machine — routine, restart immediately
     PREEMPTION = "preemption"
+    #: the RUN went bad (health sentinel: non-finite/spiking loss past
+    #: the skip-step budget) — roll back to the last *healthy*
+    #: checkpoint, perturb (LR backoff / data skip), restart immediately
+    DIVERGENCE = "divergence"
     #: transient infrastructure (I/O, lost worker, runtime) — backoff + retry
     RETRYABLE = "retryable"
     #: a code bug — retrying reruns the bug; surface it
@@ -89,11 +95,14 @@ FATAL_TYPES = (
 
 
 def classify_failure(exc: BaseException) -> FailureClass:
-    """Stock classifier: :class:`Preempted` -> PREEMPTION, known bug
+    """Stock classifier: :class:`Preempted` -> PREEMPTION,
+    :class:`~tpuframe.fault.health.Divergence` -> DIVERGENCE, known bug
     types -> FATAL, everything else (OSError, RuntimeError — XLA surfaces
     infra trouble as RuntimeError — lost workers, timeouts) -> RETRYABLE."""
     if isinstance(exc, Preempted):
         return FailureClass.PREEMPTION
+    if isinstance(exc, Divergence):
+        return FailureClass.DIVERGENCE
     if isinstance(exc, FATAL_TYPES):
         return FailureClass.FATAL
     return FailureClass.RETRYABLE
@@ -127,6 +136,10 @@ class RestartPolicy:
 
     max_restarts: int = 2
     max_preemptions: int = 16
+    #: DIVERGENCE budget — rollback-to-healthy + perturbed re-entry is
+    #: attempted this many times; past it the run surfaces the
+    #: Divergence (a model/data problem worth a human, not more retries)
+    max_divergences: int = 2
     backoff_base_s: float = 1.0
     backoff_max_s: float = 60.0
     jitter: bool = True
@@ -199,6 +212,7 @@ class Supervisor:
         self.sleep = sleep
         self.retries = 0
         self.preemptions = 0
+        self.divergences = 0
         if min_world_size < 1:
             raise ValueError(f"min_world_size must be >= 1, got {min_world_size}")
         self.capacity_probe = capacity_probe
@@ -280,10 +294,50 @@ class Supervisor:
                 "on a world too small to be worth the schedule"
             )
 
+    # -- divergence rollback -------------------------------------------------
+    def _divergence_recovery(self, error: BaseException | None = None) -> dict:
+        """The DIVERGENCE restart's extra work: roll both checkpoint
+        directories back to their last *healthy* committed step
+        (newer steps quarantined — one loud ``fault/rollback`` event
+        each) and escalate the process-wide recovery directive (LR
+        backoff compounds, data-order skip arms) that the next
+        attempt's Trainer consumes.  Without a ``checkpoint_dir`` only
+        the perturbation applies — there is nothing to roll back.
+
+        The raising Trainer's :class:`~tpuframe.fault.health.Divergence`
+        carries its policy, so a programmatic
+        ``HealthPolicy(lr_backoff=..., skip_batches=...)`` shapes the
+        perturbation; a policy-less error falls back to the env knobs."""
+        directive = _health.escalate_recovery(getattr(error, "policy", None))
+        out: dict = {
+            "lr_scale": round(directive.lr_scale, 6),
+            "skip_batches": directive.skip_batches,
+        }
+        if self.checkpoint_dir is not None:
+            from tpuframe.ckpt.checkpoint import rollback_to_last_healthy
+
+            targets: list[int | None] = []
+            for d in (self.checkpoint_dir, str(self.checkpoint_dir) + "_intra"):
+                rb = rollback_to_last_healthy(d)
+                targets.append(rb["to_step"])
+                if rb["quarantined"]:
+                    logger.warning(
+                        "divergence rollback: quarantined step(s) %s under "
+                        "%s; resuming at %s",
+                        rb["quarantined"], d, rb["to_step"],
+                    )
+            # auto-resume takes the newer of the two directories' steps
+            landed = [t for t in targets if t is not None]
+            out["rolled_back_to"] = max(landed) if landed else None
+        return out
+
     # -- the loop ------------------------------------------------------------
     def run(self, fn: Callable[..., Any]) -> Any:
         tele = get_telemetry()
         compile_cache_dir = self._ensure_compile_cache()
+        # a previous run's divergence escalations (compounded LR backoff,
+        # armed skip) must not leak into this one
+        _health.reset_recovery()
         while True:
             quarantined = self.validate_checkpoints()
             if quarantined:
@@ -300,7 +354,20 @@ class Supervisor:
                     tele.event("fault/giveup", reason="fatal",
                                error=repr(e)[:300])
                     raise
-                if cls is FailureClass.PREEMPTION:
+                rollback: dict | None = None
+                if cls is FailureClass.DIVERGENCE:
+                    self.divergences += 1
+                    attempt, budget = (
+                        self.divergences, self.policy.max_divergences
+                    )
+                    counter, delay = "fault/divergences", 0.0
+                    if attempt <= budget:
+                        # roll back + escalate the perturbation BEFORE
+                        # the restart event, so the event can say where
+                        # the next attempt re-enters; no backoff — the
+                        # rollback itself already re-trains lost steps
+                        rollback = self._divergence_recovery(e)
+                elif cls is FailureClass.PREEMPTION:
                     self.preemptions += 1
                     attempt, budget = self.preemptions, self.policy.max_preemptions
                     counter, delay = "fault/preemptions", 0.0
@@ -337,6 +404,7 @@ class Supervisor:
                     # from scratch vs one that retrieved its programs is
                     # the first question a slow-recovery report asks
                     compile_cache=compile_cache_dir,
+                    **({"rollback": rollback} if rollback else {}),
                 )
                 logger.warning(
                     "train fn failed (%s, class=%s); restart %d/%d after %.2fs",
@@ -347,7 +415,9 @@ class Supervisor:
                     # monotonic restart count across classes (budgets are
                     # per-class, but "restart N" in logs/pages must not
                     # repeat or go backwards)
-                    self.on_restart(self.retries + self.preemptions, e)
+                    self.on_restart(
+                        self.retries + self.preemptions + self.divergences, e
+                    )
                 if delay > 0:
                     self.sleep(delay)
 

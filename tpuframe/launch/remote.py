@@ -228,12 +228,17 @@ class RemoteDistributor:
         # without telemetry cannot be skew-analyzed after the fact
         # (``python -m tpuframe.track analyze`` needs every rank's log).
         from tpuframe.compile.cache import COMPILE_ENV_VARS
+        from tpuframe.fault.health import HEALTH_ENV_VARS
         from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
 
         # compile-cache knobs ride along for the same reason: a worker
         # restarted on the same host (or a new rank joining it) must hit
-        # the warm cache the driver configured, not recompile cold
-        for var in OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS:
+        # the warm cache the driver configured, not recompile cold.
+        # Health-sentinel knobs too: divergence thresholds and rollback
+        # perturbation must be fleet-uniform, or ranks disagree on
+        # whether a step was bad and the synchronous loop deadlocks on
+        # one rank raising Divergence alone
+        for var in OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS + HEALTH_ENV_VARS:
             if var in os.environ and var not in env:
                 env[var] = os.environ[var]
         env.update(

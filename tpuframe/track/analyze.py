@@ -397,6 +397,35 @@ def _compile_wall(rl: RankLog) -> dict:
     return {"wall_s": round(wall, 6), "records": n}
 
 
+def _health_info(rl: RankLog) -> dict:
+    """Training-health sentinel records in this rank's log: skipped
+    (bad) steps, divergences raised, rollbacks performed — the
+    skip -> escalate -> rollback ladder's event trail."""
+    bad_steps, bad_events, divergences = 0, 0, 0
+    rollbacks: list[dict] = []
+    for rec in rl.events:
+        name = rec.get("name")
+        if name == "health/bad_step":
+            bad_events += 1
+            try:
+                bad_steps += int(rec.get("bad_in_window", 1) or 1)
+            except (TypeError, ValueError):
+                bad_steps += 1
+        elif name == "health/divergence":
+            divergences += 1
+        elif name == "fault/rollback":
+            rollbacks.append({
+                "to_step": rec.get("to_step"),
+                "quarantined": rec.get("quarantined"),
+            })
+    return {
+        "bad_steps": bad_steps,
+        "bad_step_events": bad_events,
+        "divergences": divergences,
+        "rollbacks": rollbacks,
+    }
+
+
 def _time_to_first_step(rl: RankLog) -> float | None:
     """Seconds from this rank's first telemetry record to the end of its
     first ``train/step`` span — what a cold start actually cost the rank
@@ -500,6 +529,27 @@ def skew_report(ranks: Sequence[RankLog], *,
     }
     ttfs = {rl.rank: _time_to_first_step(rl) for rl in ranks}
     ttfs_vals = [t for t in ttfs.values() if t is not None]
+    # training-health block: present only when the sentinel left a trail
+    # (skipped steps / divergences / rollbacks) — a healthy run's report
+    # stays exactly as it was
+    per_rank_health = {rl.rank: _health_info(rl) for rl in ranks}
+    health_info = None
+    if any(
+        h["bad_step_events"] or h["divergences"] or h["rollbacks"]
+        for h in per_rank_health.values()
+    ):
+        health_info = {
+            "bad_steps": sum(h["bad_steps"] for h in per_rank_health.values()),
+            "divergences": sum(
+                h["divergences"] for h in per_rank_health.values()
+            ),
+            "rollbacks": [
+                rb for h in per_rank_health.values() for rb in h["rollbacks"]
+            ],
+            "per_rank": {
+                r: h["bad_steps"] for r, h in per_rank_health.items()
+            },
+        }
     return {
         "ranks": len(ranks),
         "hosts": sorted({rl.hostname for rl in ranks if rl.hostname}),
@@ -515,6 +565,7 @@ def skew_report(ranks: Sequence[RankLog], *,
                 for r, t in ttfs.items()
             },
         } if ttfs_vals else None,
+        "health": health_info,
         "straggler_factor": straggler_factor,
         "step_time": step_time,          # dispatch-only (baseline diffs)
         "step_wall": {                   # boundary-to-boundary

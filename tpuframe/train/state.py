@@ -48,6 +48,13 @@ class TrainState(flax.struct.PyTreeNode):
     rng: jax.Array
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    #: training-health sentinel state (``tpuframe.fault.health``): loss
+    #: EWMA + bad-step bookkeeping, a plain-dict pytree of f32 scalars
+    #: carried through the jitted step so spike detection is branch-free
+    #: on device.  Deliberately NOT serialized into checkpoints
+    #: (``ckpt._DATA_FIELDS``): a restore restarts the EWMA warmup on
+    #: fresh ground, and pre-sentinel checkpoints stay restorable.
+    health: Any = flax.struct.field(default_factory=dict)
 
     def apply_gradients(self, grads: Any, **changes: Any) -> "TrainState":
         opt_state = self.opt_state
@@ -106,7 +113,10 @@ def create_train_state(
         batch_stats = variables.get("batch_stats", {})
         return params, batch_stats, tx.init(params)
 
+    from tpuframe.fault.health import init_health_state
+
     step = jnp.zeros((), jnp.int32)
+    health = init_health_state()
     if plan is None:
         params, batch_stats, opt_state = init_fn()
     else:
@@ -128,6 +138,7 @@ def create_train_state(
         # jit device mismatch.
         step = jax.device_put(step, plan.replicated())
         state_rng = jax.device_put(state_rng, plan.replicated())
+        health = jax.device_put(health, plan.replicated())
 
     return TrainState(
         step=step,
@@ -137,6 +148,7 @@ def create_train_state(
         rng=state_rng,
         apply_fn=model.apply,
         tx=tx,
+        health=health,
     )
 
 

@@ -121,6 +121,48 @@ def _train_metrics(loss, logits, labels) -> dict:
     }
 
 
+def _apply_with_health(state: TrainState, grads: Any, new_stats: Any,
+                       loss, metrics: dict, health):
+    """The sentinel tail every train-step flavor shares
+    (``tpuframe.fault.health``): one fused grad-norm/finiteness
+    reduction + the EWMA spike test produce a scalar ``bad`` verdict,
+    and a bad step applies NO update — ``jnp.where`` selects the old
+    params/opt_state/batch_stats leaf-by-leaf, so the compiled program
+    is branch-free and the batch/AOT signature is untouched.  A bad
+    step's metrics contributions are zeroed (a NaN loss_sum would
+    poison the whole window sum); the health flags ride the metrics
+    pytree to the host, which reads them at its window cadence.
+    """
+    from tpuframe.fault.health import health_verdict
+
+    hstate = getattr(state, "health", None)
+    if not hstate:
+        raise ValueError(
+            "health-checked step needs a TrainState with a health slot; "
+            "create_train_state initializes one (or pass "
+            "health=tpuframe.fault.health.init_health_state() to replace)"
+        )
+    bad, new_hstate, hmetrics = health_verdict(
+        loss, grads, hstate, state.step, health
+    )
+    applied = state.apply_gradients(grads, batch_stats=new_stats)
+
+    def keep_old(old, new):
+        return jax.tree.map(lambda o, n: jnp.where(bad, o, n), old, new)
+
+    new_state = applied.replace(
+        params=keep_old(state.params, applied.params),
+        opt_state=keep_old(state.opt_state, applied.opt_state),
+        batch_stats=keep_old(state.batch_stats, applied.batch_stats),
+        health=new_hstate,
+    )
+    metrics = {
+        k: jnp.where(bad, jnp.zeros_like(v), v) for k, v in metrics.items()
+    }
+    metrics.update(hmetrics)
+    return new_state, metrics
+
+
 def _bind_loss(loss_fn: LossFn, plan: ParallelPlan | None) -> LossFn:
     """Give the default loss its mesh so the fused CE kernel can run
     per-shard on multi-chip meshes; custom losses pass through untouched."""
@@ -168,6 +210,7 @@ def make_train_step(
     plan: ParallelPlan | None = None,
     batch_transform: Callable[[dict], dict] | None = None,
     grad_compression: str | None = None,
+    health=None,
 ) -> Callable[[TrainState, Mapping[str, jax.Array]], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch) -> (state, metrics).
 
@@ -187,6 +230,11 @@ def make_train_step(
     i.e. shard-local statistics (torch-DDP semantics) fall out for free;
     ``bn_stats="local"``/``bn_groups`` is the GSPMD-path emulation of
     the same thing and would degenerate to per-sample groups here.
+
+    ``health`` (a :class:`tpuframe.fault.health.HealthPolicy`) arms the
+    training-health sentinel: grad-norm/finiteness + EWMA loss-spike
+    detection fused into the step, with bad steps applying no update
+    (branch-free skip) — see :func:`_apply_with_health`.
     """
     policy = policy or full_precision()
     if grad_compression is not None:
@@ -194,7 +242,8 @@ def make_train_step(
         # unbound (mesh=None) or the fused-CE kernel would open a second,
         # mismatched shard_map and crash
         return _make_compressed_train_step(
-            policy, loss_fn, donate, plan, batch_transform, grad_compression
+            policy, loss_fn, donate, plan, batch_transform, grad_compression,
+            health,
         )
     loss_fn = _bind_loss(loss_fn, plan)
 
@@ -215,8 +264,11 @@ def make_train_step(
         (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
-        new_state = state.apply_gradients(grads, batch_stats=new_stats)
-        return new_state, _train_metrics(loss, logits, batch["label"])
+        metrics = _train_metrics(loss, logits, batch["label"])
+        if health is None:
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            return new_state, metrics
+        return _apply_with_health(state, grads, new_stats, loss, metrics, health)
 
     return _wrap_offload(jax.jit(step, donate_argnums=(0,) if donate else ()), plan)
 
@@ -228,6 +280,7 @@ def _make_compressed_train_step(
     plan: ParallelPlan | None,
     batch_transform: Callable[[dict], dict] | None,
     grad_compression: str,
+    health=None,
 ):
     """shard_map train step with explicit quantized gradient sync.
 
@@ -287,12 +340,20 @@ def _make_compressed_train_step(
             else s,
             new_stats,
         )
-        new_state = state.apply_gradients(grads, batch_stats=new_stats)
         metrics = jax.tree.map(
             lambda m: jax.lax.psum(m, data_axes),
             _train_metrics(loss, logits, batch["label"]),
         )
-        return new_state, metrics
+        if health is None:
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            return new_state, metrics
+        # the verdict must be identical on every shard (params are
+        # replicated and updated in lockstep): judge the GLOBAL mean
+        # loss, not this shard's — the grads are already synced
+        return _apply_with_health(
+            state, grads, new_stats, jax.lax.pmean(loss, data_axes),
+            metrics, health,
+        )
 
     batch_spec = P(data_axes)
     mapped = shard_map(
@@ -378,6 +439,7 @@ def make_grad_accum_step(
     donate: bool = True,
     plan: ParallelPlan | None = None,
     batch_transform: Callable[[dict], dict] | None = None,
+    health=None,
 ):
     """Gradient accumulation over leading-dim microbatches via ``lax.scan``.
 
@@ -433,8 +495,16 @@ def make_grad_accum_step(
             (batch, jnp.arange(n_microbatches)),
         )
         grads = jax.tree.map(lambda g: g / n_microbatches, grads)
-        new_state = state.apply_gradients(grads, batch_stats=new_stats)
-        return new_state, metrics
+        if health is None:
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            return new_state, metrics
+        # the super-batch is the unit of update, so it is the unit of
+        # health too: one NaN microbatch poisons the accumulated grads
+        # (sum propagates it) and the whole step skips
+        mean_loss = metrics["loss_sum"] / jnp.maximum(metrics["count"], 1.0)
+        return _apply_with_health(
+            state, grads, new_stats, mean_loss, metrics, health
+        )
 
     return _wrap_offload(jax.jit(step, donate_argnums=(0,) if donate else ()), plan)
 

@@ -43,7 +43,9 @@ from tpuframe.compile.precompile import (
 from tpuframe.core import runtime as rt
 from tpuframe.data.loader import DataLoader, DevicePrefetcher
 from tpuframe.fault import chaos
+from tpuframe.fault import health as _health
 from tpuframe.fault import preempt as _preempt
+from tpuframe.fault.health import Divergence
 from tpuframe.fault.preempt import Preempted
 from tpuframe.track.analyze import StragglerMonitor
 from tpuframe.track.telemetry import get_telemetry
@@ -153,6 +155,22 @@ class Trainer:
         Default None follows ``TPUFRAME_PRECOMPILE`` (on unless set
         falsy); False opts out.  :meth:`precompile` runs the same thing
         synchronously on demand.
+      health: training-health sentinel (``tpuframe.fault.health``).
+        The jitted step computes global grad-norm + loss/grad
+        finiteness (one fused reduction) and an EWMA loss-spike test on
+        device; a bad step applies NO update (branch-free ``jnp.where``
+        skip) and its verdict rides the step's metrics pytree — the
+        Trainer reads it every ``window`` steps (one tiny device fetch,
+        not per-step sync), emits ``health/bad_step`` + counters, and
+        raises :class:`~tpuframe.fault.health.Divergence` when
+        ``max_bad`` bad steps land inside a window — the supervisor's
+        DIVERGENCE class then rolls back to the last *healthy*
+        committed checkpoint and re-enters with the configured LR
+        backoff / data-order skip.  Every save is stamped with the
+        sentinel state (loss EWMA, grad norm, bad-step count) next to
+        the topology manifest.  Default None follows ``TPUFRAME_HEALTH``
+        (on unless set falsy); False disables; a
+        :class:`~tpuframe.fault.health.HealthPolicy` sets thresholds.
     """
 
     def __init__(
@@ -190,6 +208,7 @@ class Trainer:
         straggler_sync_steps: int | None = None,
         straggler_factor: float | None = None,
         precompile: bool | None = None,
+        health: Any = None,
     ):
         if precision is None:
             # follow the model: an explicitly-bf16 model keeps bf16 compute
@@ -236,6 +255,10 @@ class Trainer:
         self._straggler = StragglerMonitor(
             sync_steps=straggler_sync_steps, factor=straggler_factor
         )
+        # training-health sentinel: the per-window buffer of the step's
+        # on-device bad-step flags (run-scoped like the straggler)
+        self.health = _health.resolve_policy(health)
+        self._health_flags: list = []
 
         if plan is None:
             plan = ParallelPlan(mesh=rt.current_runtime().mesh)
@@ -367,12 +390,14 @@ class Trainer:
             self._train_step = make_grad_accum_step(
                 grad_accum, self.policy, loss_fn, plan=self.plan,
                 batch_transform=train_transform,
+                health=self.health,
             )
         else:
             self._train_step = make_train_step(
                 self.policy, loss_fn, plan=self.plan,
                 batch_transform=train_transform,
                 grad_compression=grad_compression,
+                health=self.health,
             )
         self._eval_step = make_eval_step(
             self.policy, loss_fn, plan=self.plan, batch_transform=eval_transform
@@ -392,11 +417,26 @@ class Trainer:
         scheduler dict (``{"type": "WarmupLR", "params": {...}}`` or a full
         config carrying a ``"scheduler"`` key — `deepspeed_config.py:33-40`);
         ``total_num_steps: "auto"`` resolves against max_duration and the
-        train dataloader."""
-        return resolve_schedule(
+        train dataloader.
+
+        A divergence-recovery directive (``fault.health``: the
+        supervisor escalates one per rollback) scales the resolved
+        schedule by its compounded LR backoff — the perturbation that
+        keeps a deterministic replay from re-hitting the same spike.
+        Wrapping the *schedule* (not the optimizer chain) keeps the
+        opt_state structure identical, so the rolled-back checkpoint
+        restores cleanly."""
+        schedule = resolve_schedule(
             lr,
             total_steps=_planned_total_steps(self.max_duration, self.train_dataloader),
         )
+        scale = _health.recovery_directive().lr_scale
+        if scale == 1.0:
+            return schedule
+        get_telemetry().event("health/lr_backoff", lr_scale=round(scale, 6))
+        if callable(schedule):
+            return lambda step: schedule(step) * scale
+        return schedule * scale
 
     @property
     def is_main(self) -> bool:
@@ -518,7 +558,8 @@ class Trainer:
                 with tele.span(
                     "fault/preempt_checkpoint", step=self.batches_seen
                 ), tele.guard("ckpt/save"):
-                    path = intra.save(self.state, meta=meta, plan=self.plan)
+                    path = intra.save(self.state, meta=meta, plan=self.plan,
+                                      health=self._health_stamp())
                     intra.wait()  # synchronous: the machine is going away
         # no counter here: fault/preempt_notices counted at the watcher,
         # fault/preemptions at the supervisor's restart — incrementing a
@@ -538,6 +579,90 @@ class Trainer:
             # (a real preemption replaces the process; clearing is moot)
             watcher.clear()
         raise Preempted(reason, step=self.batches_seen, checkpoint=path)
+
+    # -- training health -----------------------------------------------------
+    def _health_step(self, metrics: Mapping[str, Any]) -> None:
+        """Buffer the step's on-device bad flag; check per window.
+
+        The buffer holds the scalar flag arrays un-fetched (a list
+        append — zero dispatch, zero sync on the hot path); the only
+        host sync is the once-per-window fused fetch in
+        :meth:`_health_check`, so the sentinel costs the hot loop
+        nothing between checks."""
+        if self.health is None:
+            return
+        stats = metrics.get("health_stats")
+        if stats is None:
+            return
+        self._health_flags.append(stats)
+        if len(self._health_flags) >= self.health.window:
+            self._health_check()
+
+    def _health_check(self) -> None:
+        """Materialize the window's verdict: gauges + ``health/bad_step``
+        events, and the escalation — ``max_bad`` bad steps inside the
+        window raises :class:`Divergence` for the supervisor's rollback
+        ladder."""
+        import math
+
+        if self.health is None or not self._health_flags:
+            return
+        stats = jax.device_get(self._health_flags)
+        n_bad = int(round(sum(float(s[0]) for s in stats)))
+        window_steps = len(stats)
+        self._health_flags = []
+        tele = get_telemetry()
+        hs = {
+            k: float(v) for k, v in jax.device_get(self.state.health).items()
+        }
+        for key, name in (("loss_ewma", "health/loss_ewma"),
+                          ("grad_norm", "health/grad_norm")):
+            if math.isfinite(hs.get(key, float("nan"))):
+                tele.registry.gauge(name).set(hs[key])
+        if not n_bad:
+            return
+        tele.registry.counter("health/bad_steps").inc(n_bad)
+        tele.event(
+            "health/bad_step",
+            batch=self.batches_seen,
+            bad_in_window=n_bad,
+            window_steps=window_steps,
+            bad_steps_total=int(hs.get("bad_steps", 0.0)),
+            loss_ewma=hs["loss_ewma"] if math.isfinite(hs["loss_ewma"]) else None,
+            grad_norm=hs["grad_norm"] if math.isfinite(hs["grad_norm"]) else None,
+        )
+        if n_bad >= self.health.max_bad:
+            tele.registry.counter("health/divergences").inc()
+            tele.event(
+                "health/divergence",
+                batch=self.batches_seen,
+                bad_in_window=n_bad,
+                window_steps=window_steps,
+                max_bad=self.health.max_bad,
+            )
+            raise Divergence(
+                f"{n_bad} bad step(s) inside a {window_steps}-step health "
+                f"window (max_bad={self.health.max_bad}) at batch "
+                f"{self.batches_seen}: skip-step is no longer converging",
+                step=self.batches_seen,
+                bad_in_window=n_bad,
+                window=window_steps,
+                loss_ewma=hs.get("loss_ewma"),
+                policy=self.health,
+            )
+
+    def _health_stamp(self) -> dict | None:
+        """The health record stamped into every save's meta JSON (next
+        to the topology manifest): loss EWMA, grad norm, bad-step count,
+        and the ``healthy`` verdict rollback selects on."""
+        if self.health is None or self.state is None:
+            return None
+        hs = jax.device_get(self.state.health)
+        if not hs:
+            return None
+        return _health.health_stamp(
+            hs, int(jax.device_get(self.state.step)), self.health
+        )
 
     def _log_metrics(self, metrics: Mapping[str, float], step: int) -> None:
         if not self.is_main:
@@ -738,12 +863,23 @@ class Trainer:
             return x.reshape((accum, micro) + x.shape[1:])
 
         def host_iter():
-            for batch in loader:
+            # consumption index of this epoch's first yielded batch —
+            # the prefetcher runs this generator ahead of training, but
+            # batch i of the epoch is consumed at step base+i, so chaos
+            # scheduled by step fires on exactly the batch that step eats
+            base = self.batches_seen
+            for pos, batch in enumerate(loader):
                 images, labels = np.asarray(batch[0]), np.asarray(batch[1])
                 if algs:
                     images, labels = apply_algorithms(
                         algs, images, labels, batch_rng()
                     )
+                # chaos site: poison the HOST batch in place (NaNAt /
+                # SpikeAt) exactly where a corrupt record or a broken
+                # augmentation would land — upstream of the device copy,
+                # so the jitted step's sentinel sees it like the real thing
+                if train:
+                    chaos.maybe_fire("batch", step=base + pos, images=images)
                 out = {"image": images, "label": labels}
                 if len(batch) > 2:
                     out["weight"] = np.asarray(batch[2], np.float32)
@@ -834,6 +970,42 @@ class Trainer:
                         "tpuframe.launch.rederive_batch_split(global_batch="
                         f"{saved_gb}, dp_size={self.plan.dp_size})"
                     )
+        # divergence-recovery data-order skip: after a rollback the
+        # supervisor may direct this attempt to re-enter PAST the poison
+        # window instead of deterministically replaying into it.
+        # Applied on top of whatever loader position the restore carried
+        # — INCLUDING a restore-less fresh start (every step quarantined,
+        # or no checkpointer at all: the perturbation half of recovery
+        # must not depend on there being something to roll back to).
+        # One-shot: consumed here so a later unrelated restart in the
+        # same run doesn't re-skip healthy batches.
+        skip = (
+            _health.consume_skip_batches()
+            if self.health is not None
+            and hasattr(self.train_dataloader, "load_state_dict")
+            else 0
+        )
+        if skip:
+            ls = self._pending_loader_state
+            if ls is None:
+                ls = self.train_dataloader.state_dict()
+                ls["epoch"] = self.epoch
+                ls["batches_yielded"] = 0
+            ls = dict(ls)
+            try:
+                epoch_len = len(self.train_dataloader)
+            except TypeError:
+                epoch_len = int(ls["batches_yielded"]) + skip
+            ls["batches_yielded"] = min(
+                int(ls["batches_yielded"]) + skip, epoch_len
+            )
+            self._pending_loader_state = ls
+            get_telemetry().event(
+                "health/skip_batches",
+                skip=skip,
+                batches_yielded=ls["batches_yielded"],
+                epoch=int(ls.get("epoch", self.epoch)),
+            )
 
         if self.precompile_enabled:
             # background AOT warm-start, overlapped with the epoch's
@@ -889,6 +1061,7 @@ class Trainer:
                             "global_batch": self.train_dataloader.global_batch_size,
                         },
                         plan=self.plan,
+                        health=self._health_stamp(),
                     )
                     result.checkpoint = str(ckpt_path)
                     # An epoch-end save supersedes any mid-epoch snapshot
@@ -973,7 +1146,17 @@ class Trainer:
             """Materialize the device-side window (the only host sync)."""
             nonlocal host_block
             with tele.span("train/host_block", emit=False) as sp:
-                out = {k: float(v) for k, v in window.items()}
+                out = {
+                    k: float(v) for k, v in window.items()
+                    if k != "health_stats"
+                }
+                # the sentinel's packed vector splits into its named
+                # scalar sums (one device leaf on the hot path, five
+                # host columns in the summary)
+                if "health_stats" in window:
+                    out.update(
+                        _health.unpack_health_stats(window["health_stats"])
+                    )
             host_block += sp.elapsed
             return out
 
@@ -1012,6 +1195,11 @@ class Trainer:
             # boundary-to-boundary step time: charges whatever actually
             # slowed this rank (wait, dispatch, snapshot, callback)
             self._straggler.observe()
+            # health sentinel: accumulate the step's bad-flag on device
+            # (async, like the metrics window) and check once per window
+            # — may raise Divergence, BEFORE this step's interval
+            # snapshot would write yet another doomed checkpoint
+            self._health_step(metrics)
             if (
                 self.checkpointer is not None
                 and self.checkpoint_interval_batches
@@ -1042,6 +1230,7 @@ class Trainer:
                             "global_batch": self.train_dataloader.global_batch_size,
                         },
                         plan=self.plan,
+                        health=self._health_stamp(),
                     )
             # step boundary = the preemption exit point: the step is the
             # atomic unit of progress, so a SIGTERM/maintenance notice is
@@ -1069,6 +1258,9 @@ class Trainer:
             w = drain(window)
             acc = merge_metrics(acc, w)
             self._emit("on_batch_end", w)
+        # flush the partial health window: max_bad bad steps are max_bad
+        # bad steps whether or not the window filled before epoch end
+        self._health_check()
         elapsed = time.perf_counter() - t0
         summary = summarize_metrics(acc or {}, prefix="train_")
         if acc:
@@ -1076,6 +1268,18 @@ class Trainer:
             # it is already the global sample count — no process factor
             # (multiplying by process_count over-reported N x on pods).
             summary["train_samples_per_sec"] = acc.get("count", 0.0) / max(elapsed, 1e-9)
+        if self.health is not None and acc:
+            summary["health_bad_steps"] = acc.get("health_bad", 0.0)
+            # mean over FINITE steps only: grad_norm_sum zeroes the
+            # non-finite ones, so they must leave the denominator too
+            finite_steps = (
+                acc.get("health_steps", 0.0)
+                - acc.get("health_nonfinite", 0.0)
+            )
+            if finite_steps > 0:
+                summary["grad_norm"] = (
+                    acc.get("grad_norm_sum", 0.0) / finite_steps
+                )
         summary["epoch_time_s"] = elapsed
         summary["data_wait_s"] = data_wait
         summary["dispatch_s"] = dispatch
