@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Closed-loop serving benchmark: throughput vs latency-SLO, plus a
+measured overload run proving latency stays bounded while throughput
+saturates.
+
+The serving counterpart of ``bench_e2e``'s producer ceiling: stands up
+the real :class:`tpuframe.serve.ServeEngine` (bucketed dynamic batching,
+AOT-precompiled shapes, bounded-queue admission control) over an
+exported StableHLO artifact and drives it two ways:
+
+1. **Closed-loop sweep** — ``c`` client threads, each submitting its
+   next request the moment the previous one returns, per concurrency
+   level.  Reports throughput (req/s) and the latency distribution per
+   level; the best-throughput level's distribution is committed as the
+   ``serve_latency`` block that ``python -m tpuframe.track analyze
+   --baseline`` gates p99 regressions against (exit 3), exactly like
+   ``step_time``/``time_to_first_step``.
+2. **Overload run** — the seeded :class:`~tpuframe.fault.chaos.QueueFlood`
+   injector floods a small-cap queue (policy ``shed-oldest``) while
+   closed-loop clients keep submitting.  The record proves the
+   robustness headline: shed/reject verdicts fire, throughput saturates,
+   and the p99 of *admitted* requests stays under the SLO — overload
+   degrades honestly instead of melting into unbounded queue wait.
+
+Zero ``compile/recompile`` events across the whole run is asserted into
+the record: every served batch hit a precompiled bucket shape.
+
+Prints ONE JSON line (committed as
+``benchmarks/results/bench_serve_cpu.json``; the capture ladder re-runs
+it on a live TPU window).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def _pctl(sorted_vals, q):
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def _latency_block(lats_s):
+    lats = sorted(lats_s)
+    if not lats:
+        return None
+    return {
+        "count": len(lats),
+        "mean": round(sum(lats) / len(lats), 6),
+        "p50": round(_pctl(lats, 0.50), 6),
+        "p95": round(_pctl(lats, 0.95), 6),
+        "p99": round(_pctl(lats, 0.99), 6),
+    }
+
+
+def build_artifact(path: str, image_size: int, classes: int) -> str:
+    import jax
+    import numpy as np
+
+    from tpuframe.models import MnistNet
+    from tpuframe.serve import export_model
+
+    model = MnistNet(num_classes=classes)
+    sample = np.zeros((1, image_size, image_size, 1), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), sample, train=False)
+    return export_model(model, variables, sample, path)
+
+
+def closed_loop(engine, payloads, clients: int, per_client: int):
+    """``clients`` threads, each submitting back-to-back; returns
+    (wall_s, latencies_s, errors) over the whole run."""
+    from tpuframe.serve import RequestRejected, RequestShed
+
+    lats: list[float] = []
+    errors = {"rejected": 0, "shed": 0}
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        rng_off = ci * per_client
+        for i in range(per_client):
+            x = payloads[(rng_off + i) % len(payloads)]
+            try:
+                res = engine.submit(x)
+                res.result(timeout=60)
+            except RequestRejected:
+                with lock:
+                    errors["rejected"] += 1
+            except RequestShed:
+                with lock:
+                    errors["shed"] += 1
+            else:
+                with lock:
+                    lats.append(res.latency_s)
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, lats, errors
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--export", default=None,
+                    help="existing artifact (default: build a small "
+                         "MnistNet export in --workdir)")
+    ap.add_argument("--workdir", default="/tmp/tpuframe_bench_serve")
+    ap.add_argument("--image-size", type=int, default=28)
+    ap.add_argument("--clients", default="1,4,8",
+                    help="comma list of closed-loop concurrency levels")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="requests per client per level (0 = by backend)")
+    ap.add_argument("--buckets", default="1,4,8")
+    ap.add_argument("--slo-ms", type=float, default=1000.0)
+    ap.add_argument("--overload-flood", type=int, default=200,
+                    help="QueueFlood size for the overload run")
+    ap.add_argument("--overload-cap", type=int, default=8,
+                    help="admission queue cap under overload")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from tpuframe.fault.chaos import ChaosPlan, QueueFlood
+    from tpuframe.serve import ServeEngine, ServeKnobs, load_model
+    from tpuframe.track.telemetry import get_telemetry
+
+    backend = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
+    os.makedirs(args.workdir, exist_ok=True)
+    artifact = args.export or build_artifact(
+        os.path.join(args.workdir, "bench_serve.shlo"), args.image_size, 10
+    )
+    served = load_model(artifact)
+    item_shape = tuple(served.meta["input_shape"][1:])
+    dtype = served.meta["input_dtype"]
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    per_client = args.requests or (40 if backend == "cpu" else 200)
+    rng = np.random.default_rng(args.seed)
+    payloads = [rng.random(item_shape, dtype=np.float32).astype(dtype)
+                for _ in range(32)]
+
+    reg = get_telemetry().registry
+    recompiles0 = reg.counter("compile/recompiles").value
+
+    # ---- closed-loop throughput-vs-latency sweep -------------------------
+    sweep = []
+    for clients in (int(c) for c in args.clients.split(",")):
+        knobs = ServeKnobs(buckets=buckets, slo_ms=args.slo_ms,
+                           queue_cap=256, batch_wait_ms=1.0)
+        eng = ServeEngine(served, knobs=knobs).start()
+        # warmup: first round-trip per bucket pays dispatch plumbing
+        eng.submit(payloads[0]).result(timeout=60)
+        wall, lats, errors = closed_loop(eng, payloads, clients, per_client)
+        eng.drain(timeout=30)
+        block = _latency_block(lats)
+        sweep.append({
+            "clients": clients,
+            "requests": len(lats),
+            "rps": round(len(lats) / wall, 1),
+            "latency": block,
+            "p50_ms": round(block["p50"] * 1e3, 2),
+            "p99_ms": round(block["p99"] * 1e3, 2),
+            **({"errors": errors} if any(errors.values()) else {}),
+        })
+        print(f"# clients={clients}: {sweep[-1]['rps']} req/s "
+              f"p50={sweep[-1]['p50_ms']}ms p99={sweep[-1]['p99_ms']}ms",
+              file=sys.stderr)
+    best = max(sweep, key=lambda s: s["rps"])
+
+    # ---- overload: seeded flood against a small-cap shed-oldest queue ----
+    knobs = ServeKnobs(buckets=buckets, slo_ms=args.slo_ms,
+                       queue_cap=args.overload_cap,
+                       shed_policy="shed-oldest", batch_wait_ms=1.0)
+    eng = ServeEngine(served, knobs=knobs).start()
+    eng.submit(payloads[0]).result(timeout=60)
+    shed0 = reg.counter("serve/shed").value
+    rej0 = reg.counter("serve/rejected").value
+    served0 = reg.counter("serve/requests_served").value
+    # the flood fires deterministically at the 5th submitted request —
+    # the same injector (and seed discipline) the chaos tests use
+    plan = ChaosPlan([QueueFlood(args.overload_flood, step=5,
+                                 deadline_ms=args.slo_ms)])
+    with plan.active():
+        wall, lats, errors = closed_loop(eng, payloads, 8, per_client)
+    eng.drain(timeout=60)
+    shed = reg.counter("serve/shed").value - shed0
+    rejected = reg.counter("serve/rejected").value - rej0
+    served_n = reg.counter("serve/requests_served").value - served0
+    admitted_block = _latency_block(lats)
+    overload = {
+        "flood": args.overload_flood,
+        "queue_cap": args.overload_cap,
+        "shed_policy": "shed-oldest",
+        "wall_s": round(wall, 3),
+        "served": int(served_n),
+        "throughput_rps": round(served_n / wall, 1),
+        "shed": int(shed),
+        "rejected": int(rejected),
+        "client_latency": admitted_block,
+        "admitted_p99_ms": round(admitted_block["p99"] * 1e3, 2),
+        "slo_ms": args.slo_ms,
+        "p99_under_slo": admitted_block["p99"] * 1e3 <= args.slo_ms,
+        "degradation": "bounded: sheds fired, admitted p99 held the SLO"
+        if shed and admitted_block["p99"] * 1e3 <= args.slo_ms
+        else "CHECK: expected sheds + bounded admitted p99",
+    }
+    recompiles = reg.counter("compile/recompiles").value - recompiles0
+
+    record = {
+        "metric": "serve_throughput_rps",
+        "value": best["rps"],
+        "unit": ("closed-loop served requests/s at the best concurrency "
+                 f"level (MnistNet {args.image_size}px, buckets "
+                 f"{list(buckets)}, dynamic batching, {backend})"),
+        "backend": backend,
+        "device_kind": device_kind,
+        "buckets": list(buckets),
+        "slo_ms": args.slo_ms,
+        "per_client_requests": per_client,
+        # the baseline-gated block: `track analyze --baseline` ratios
+        # p99 against this, exit 3 on regression (seconds, like step_time)
+        "serve_latency": best["latency"],
+        "sweep": [{k: v for k, v in s.items() if k != "latency"}
+                  for s in sweep],
+        "overload": overload,
+        "recompile_events": int(recompiles),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

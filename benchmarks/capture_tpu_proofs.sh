@@ -166,6 +166,14 @@ run bench_fault_divergence.json 300 python benchmarks/bench_fault.py --divergenc
 # cheap, so it rides with the fault rung above the long tail
 run analyze_selftest.json      300  python benchmarks/bench_analyze.py
 
+# serving rung: closed-loop throughput-vs-latency sweep + the seeded
+# QueueFlood overload run over the real ServeEngine (bucketed dynamic
+# batching, AOT-precompiled shapes) — on the TPU host this prices the
+# real per-bucket inference wall and commits the serve_latency block
+# that `track analyze --baseline` gates request-path p99 regressions
+# against (SERVE.md); cheap, rides with the fault/analyze pair
+run bench_serve.json           300  python benchmarks/bench_serve.py
+
 # compile-spine rung: cold vs warm-cache vs AOT-overlapped
 # time-to-first-step on the real chip — the committed
 # time_to_first_step block is what `track analyze --baseline` gates

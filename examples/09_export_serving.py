@@ -7,6 +7,13 @@ freeze it WITH its preprocessing into one StableHLO blob
 (``tpuframe.serve``), then reload it the way a serving box would — no
 trainer, no flax module, no checkpoint — and time batched inference.
 
+Then stands up the real serving spine over the artifact: a
+:class:`~tpuframe.serve.ServeEngine` (deadline-aware dynamic batching
+into AOT-precompiled bucket shapes, bounded-queue admission control,
+graceful drain — SERVE.md) and fires a small closed-loop load generator
+at it, printing the throughput and latency distribution the production
+bench (``benchmarks/bench_serve.py``) commits at full scale.
+
 Also demonstrates the migration entry: ``--from-torch <state_dict.pt>``
 skips training and exports a torchvision-format checkpoint directly
 (uses the committed width-4 ResNet18 test fixture by default shape).
@@ -37,6 +44,11 @@ def main() -> None:
                     help="torchvision-format ResNet18 state_dict .pt; "
                          "skips training and exports it directly")
     ap.add_argument("--serve-batch", type=int, default=64)
+    ap.add_argument("--clients", type=int, default=4,
+                    help="closed-loop load-generator clients against the "
+                         "ServeEngine (0 skips the engine demo)")
+    ap.add_argument("--requests", type=int, default=16,
+                    help="requests per client")
     args = ap.parse_args()
     rt = core.initialize()
     os.makedirs(args.workdir, exist_ok=True)
@@ -107,6 +119,44 @@ def main() -> None:
     print(f"serving batch={args.serve_batch}: {dt*1000:.2f} ms/batch "
           f"({args.serve_batch/dt:.0f} img/s) on {rt.platform}; "
           f"logits {logits.shape}")
+
+    # ---- the serving spine: engine + closed-loop load --------------------
+    if args.clients:
+        import threading
+
+        from tpuframe.serve import ServeEngine, ServeKnobs
+
+        knobs = ServeKnobs(buckets=(1, 4, 8), slo_ms=5000.0,
+                           batch_wait_ms=1.0)
+        engine = ServeEngine(served, knobs=knobs).start()
+        rng = np.random.default_rng(1)
+        lats: list[float] = []
+        lock = threading.Lock()
+
+        def client(k: int) -> None:
+            for _ in range(args.requests):
+                x = (rng.integers(0, 255, shape)
+                     .astype(sample_dtype))
+                res = engine.submit(x)
+                res.result(timeout=30)
+                with lock:
+                    lats.append(res.latency_s)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(args.clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        engine.drain(timeout=30)
+        lats.sort()
+        p = lambda q: lats[min(len(lats) - 1, int(q * len(lats)))]  # noqa: E731
+        print(f"engine: {len(lats)} requests from {args.clients} "
+              f"closed-loop clients in {wall:.2f}s "
+              f"({len(lats)/wall:.0f} req/s); latency p50="
+              f"{p(.5)*1e3:.1f}ms p95={p(.95)*1e3:.1f}ms; drained cleanly")
     print("finished")
 
 

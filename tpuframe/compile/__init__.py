@@ -40,6 +40,7 @@ _LAZY = {
     "batch_signature": "tpuframe.compile.precompile",
     "format_signature": "tpuframe.compile.precompile",
     "loader_batch_template": "tpuframe.compile.precompile",
+    "precompile_call": "tpuframe.compile.precompile",
     "precompile_step": "tpuframe.compile.precompile",
 }
 
@@ -57,6 +58,7 @@ __all__ = [
     "enabled_dir",
     "format_signature",
     "loader_batch_template",
+    "precompile_call",
     "precompile_step",
     "trim",
 ]

@@ -50,6 +50,7 @@ __all__ = [
     "batch_signature",
     "format_signature",
     "loader_batch_template",
+    "precompile_call",
     "precompile_step",
 ]
 
@@ -170,11 +171,12 @@ def loader_batch_template(trainer, train: bool) -> dict | None:
     return out
 
 
-def precompile_step(fn, state, template: dict, *, label: str):
-    """AOT-lower and backend-compile ``fn(state, template_batch)``.
+def precompile_call(fn, abstract_args: tuple, *, label: str):
+    """AOT-lower and backend-compile ``fn(*abstract_args)`` — the
+    generic form shared by the train step and the serve engine's bucket
+    warmup.
 
-    ``fn`` is a step callable from ``tpuframe.train.step`` — either the
-    jitted function itself or an offload wrapper exposing ``_inner_jit``.
+    ``fn`` is a jitted callable (or a wrapper exposing ``_inner_jit``).
     Returns the compiled executable when it is directly dispatchable
     (i.e. ``fn`` IS the jitted function — wrappers do per-call host work
     the executable wouldn't), else None; in both cases the compile has
@@ -184,13 +186,18 @@ def precompile_step(fn, state, template: dict, *, label: str):
     if not hasattr(target, "lower"):
         return None
     tele = get_telemetry()
-    astate = abstract_state(state)
     with tele.span("compile/lower", label=label):
-        lowered = target.lower(astate, template)
+        lowered = target.lower(*abstract_args)
     with tele.span("compile/backend_compile", label=label), \
             compile_label(label, span=True):
         compiled = lowered.compile()
     return compiled if target is fn else None
+
+
+def precompile_step(fn, state, template: dict, *, label: str):
+    """AOT-lower and backend-compile ``fn(state, template_batch)`` (the
+    Trainer's entry into :func:`precompile_call`)."""
+    return precompile_call(fn, (abstract_state(state), template), label=label)
 
 
 class ShapeGuard:

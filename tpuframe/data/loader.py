@@ -109,7 +109,10 @@ class BatchBufferPool:
     completes).  Consumers that never release simply cause fresh
     allocations — exactly the old behavior, made visible through the
     ``data/ring_allocs`` counter (steady-state zero when recycling
-    works).
+    works).  The serve engine (``tpuframe.serve.engine``) is the second
+    consumer: one pool per padded request bucket, leased per inference
+    batch and released after the device copy — same zero-allocation
+    steady state, same aliasing guards.
 
     Buffers are allocated off XLA's 64-byte zero-copy grain (see
     ``_alloc_unaliasable``) so a recycled buffer can never alias live

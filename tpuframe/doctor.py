@@ -253,7 +253,63 @@ def health_section(directory: str | None = None) -> dict:
     return out
 
 
-def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None) -> dict:
+def serve_section(export_path: str | None = None) -> dict:
+    """State of the serving spine (``tpuframe.serve``): the live SLO /
+    queue / shed-policy knobs (env overrides applied), the
+    ``TPUFRAME_SERVE_*`` env, and — given an export artifact
+    (``--export`` / ``TPUFRAME_SERVE_EXPORT``) — its meta plus the
+    padded bucket shapes the engine would AOT-precompile for it, with
+    the paste-ready ``bench_serve`` one-liner.  Stdlib-only reads
+    (:func:`~tpuframe.serve.admission.read_export_meta`) — works
+    against a wedged backend, like the ckpt/health sections."""
+    import dataclasses
+
+    from tpuframe.serve.admission import SERVE_ENV_VARS, ServeKnobs
+
+    knobs = ServeKnobs.from_env()
+    out: dict = {
+        "knobs": dataclasses.asdict(knobs),
+        "env": {
+            k: os.environ[k] for k in SERVE_ENV_VARS if k in os.environ
+        },
+        "bench": "python benchmarks/bench_serve.py",
+    }
+    export_path = export_path or os.environ.get("TPUFRAME_SERVE_EXPORT")
+    if export_path:
+        from tpuframe.serve.admission import read_export_meta
+
+        out["bench"] = (
+            f"python benchmarks/bench_serve.py --export "
+            f"{shlex.quote(export_path)}"
+        )
+        try:
+            meta = read_export_meta(export_path)
+        except (OSError, ValueError) as e:
+            out["export"] = {"path": export_path, "error": str(e)}
+        else:
+            trailing = list(meta.get("input_shape") or [])[1:]
+            out["export"] = {
+                "path": os.path.abspath(export_path),
+                "model": meta.get("model"),
+                "version": meta.get("version"),
+                "input_shape": meta.get("input_shape"),
+                "input_dtype": meta.get("input_dtype"),
+                "batch_polymorphic": meta.get("batch_polymorphic"),
+                "platforms": meta.get("platforms"),
+                # the closed shape set the engine precompiles at start();
+                # anything else at runtime is one loud compile/recompile
+                "bucket_shapes": [[b] + trailing for b in knobs.buckets],
+                "aot_precompile": (
+                    "armed at ServeEngine.start() via compile.precompile "
+                    "(persistent cache warm; ShapeGuard loud on stray "
+                    "shapes)"
+                ),
+            }
+    return out
+
+
+def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
+           export_path: str | None = None) -> dict:
     """Collect the full environment report (pure data; printing is main's)."""
     import tpuframe
 
@@ -296,13 +352,24 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None) -> dict:
         "compile": compile_section(),
         "ckpt": ckpt_section(ckpt_dir, devices.get("device_count")),
         "health": health_section(ckpt_dir),
+        "serve": serve_section(export_path),
         "env": {
             k: os.environ[k]
             for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
                       "TPUFRAME_DEBUG")
             if k in os.environ
         },
+        # every spine knob that is actually set, off the one aggregated
+        # registry (launch.remote.all_env_vars — the same list shipped
+        # to remote workers), so a bug report carries the full config
+        "knobs_set": _knobs_set(),
     }
+
+
+def _knobs_set() -> dict:
+    from tpuframe.launch.remote import all_env_vars
+
+    return {k: os.environ[k] for k in all_env_vars() if k in os.environ}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -318,8 +385,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="checkpoint directory to report on (committed "
                          "steps + the latest step's topology manifest; "
                          "default: TPUFRAME_CKPT_DIR)")
+    ap.add_argument("--export", default=None, dest="export_path",
+                    help="serve export artifact to report on (meta + "
+                         "AOT bucket shapes + the bench_serve one-liner; "
+                         "default: TPUFRAME_SERVE_EXPORT)")
     args = ap.parse_args(argv)
-    rec = report(args.probe_timeout, args.ckpt_dir)
+    rec = report(args.probe_timeout, args.ckpt_dir, args.export_path)
     print(json.dumps(rec, indent=2))
     return 1 if "error" in rec["devices"] else 0
 

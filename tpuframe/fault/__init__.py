@@ -8,8 +8,8 @@ natively on tpuframe's Checkpointer + telemetry spine:
   last-chance checkpoints, multi-host agreement, :class:`Preempted` status
 - ``fault.chaos``      — deterministic seeded fault injection at named
   call sites (loader raise, step stall, torn checkpoint, worker kill,
-  preemption notice, NaN/spike batch poison) — recovery is *tested*,
-  not assumed
+  preemption notice, NaN/spike batch poison, serve queue flood / slow
+  consumer / poison request) — recovery is *tested*, not assumed
 - ``fault.supervisor`` — restart orchestration: per-failure-class budgets,
   exponential backoff with full jitter, pre-resume quarantine of torn
   checkpoint steps, divergence rollback to the last healthy checkpoint
@@ -30,9 +30,12 @@ from tpuframe.fault.chaos import (
     KillWorker,
     LoseRank,
     NaNAt,
+    PoisonRequest,
     PreemptNotice,
+    QueueFlood,
     RaiseAt,
     RankLostError,
+    SlowConsumer,
     SpikeAt,
     StallAt,
     TornCheckpoint,
@@ -75,12 +78,15 @@ __all__ = [
     "LoseRank",
     "NaNAt",
     "PREEMPTED_EXIT",
+    "PoisonRequest",
     "Preempted",
     "PreemptNotice",
     "PreemptionWatcher",
+    "QueueFlood",
     "RaiseAt",
     "RankLostError",
     "RestartPolicy",
+    "SlowConsumer",
     "SpikeAt",
     "StallAt",
     "Supervisor",

@@ -24,6 +24,17 @@ site               where
                    transient-IO retry window)
 ``ckpt/saved``     Checkpointer.save, after the write (ctx: ``path``) —
                    where :class:`TornCheckpoint` tears the commit marker
+``serve/submit``   ServeEngine.submit, before door validation (ctx:
+                   ``payload``, ``engine``) — where :class:`PoisonRequest`
+                   corrupts the client payload validation must reject
+``serve/enqueue``  ServeEngine.submit, after validation / before
+                   admission (ctx: ``engine``) — where :class:`QueueFlood`
+                   floods the bounded queue with synthetic load
+``serve/batch``    ServeEngine batcher, before batch assembly (ctx:
+                   ``n``, ``bucket``, ``engine``)
+``serve/infer``    ServeEngine batcher, inside the backend-call span —
+                   where :class:`SlowConsumer` wedges the backend under
+                   the serve watchdog lease
 =================  =========================================================
 
 Library code can add sites with :func:`site`/:func:`maybe_fire`; tests
@@ -54,9 +65,12 @@ __all__ = [
     "KillWorker",
     "LoseRank",
     "NaNAt",
+    "PoisonRequest",
     "PreemptNotice",
+    "QueueFlood",
     "RaiseAt",
     "RankLostError",
+    "SlowConsumer",
     "SpikeAt",
     "StallAt",
     "TornCheckpoint",
@@ -309,6 +323,87 @@ class SpikeAt(_BatchPoison):
     def fire(self, ctx: Mapping[str, Any]) -> None:
         images = self._images(ctx)
         images *= self.scale
+
+
+class QueueFlood(Injector):
+    """Flood the serve engine's bounded admission queue with ``n``
+    synthetic requests — the deterministic overload: one firing drives
+    the queue past its cap so shed/reject verdicts, occupancy, and the
+    bounded-latency claim are all testable without n client threads.
+    Fires at ``serve/enqueue`` (ctx carries ``engine``); ``step`` counts
+    submitted requests at that engine."""
+
+    def __init__(self, n: int = 64, step: int | None = None, *,
+                 site: str = "serve/enqueue", deadline_ms: float | None = None,
+                 times: int = 1):
+        super().__init__(site, step, times=times)
+        self.n = int(n)
+        self.deadline_ms = deadline_ms
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        engine = ctx.get("engine")
+        if engine is None or not hasattr(engine, "flood"):
+            # ValueError: a misconfigured drill is FATAL-class — fail the
+            # drill fast instead of burning restart budget on it
+            raise ValueError(
+                f"QueueFlood fired at site {self.site!r} which carries no "
+                "serve engine — schedule it at the 'serve/enqueue' site"
+            )
+        engine.flood(self.n, deadline_ms=self.deadline_ms)
+
+    def describe(self) -> str:
+        return (f"QueueFlood(n={self.n}, site={self.site!r}, "
+                f"step={self.step})")
+
+
+class SlowConsumer(Injector):
+    """Wedge the serving backend: sleep ``stall_s`` inside the
+    ``serve/infer`` span — a slow/hung model call in miniature.  Pairs
+    with the serve watchdog lease (``TPUFRAME_SERVE_WATCHDOG_S``): the
+    injected hang should produce an attributed stall report naming
+    ``serve/infer``, and queued requests behind it should shed on their
+    deadlines instead of waiting forever."""
+
+    def __init__(self, step: int | None = None, *, stall_s: float = 1.0,
+                 site: str = "serve/infer", times: int = 1):
+        super().__init__(site, step, times=times)
+        self.stall_s = float(stall_s)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        time.sleep(self.stall_s)
+
+
+class PoisonRequest(Injector):
+    """Corrupt one submitted payload (NaN) upstream of door validation —
+    the serve-path :class:`NaNAt`.  The contract under test: validation
+    rejects it with :class:`~tpuframe.serve.admission.InvalidRequest`
+    and its would-be batch-mates serve unaffected (one poison request
+    must never NaN a shared batch).  Fires at ``serve/submit`` (ctx
+    carries the host ``payload``); float payloads only, like
+    :class:`_BatchPoison` — a uint8 payload can't represent the poison,
+    so the drill raises instead of passing vacuously."""
+
+    def __init__(self, step: int | None = None, *, site: str = "serve/submit",
+                 times: int = 1):
+        super().__init__(site, step, times=times)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        payload = ctx.get("payload")
+        if payload is None:
+            raise ValueError(
+                f"PoisonRequest fired at site {self.site!r} which carries "
+                "no request payload — schedule it at the 'serve/submit' site"
+            )
+        if getattr(payload.dtype, "kind", None) != "f":
+            raise ValueError(
+                f"PoisonRequest cannot poison a {payload.dtype} payload "
+                "(integer transfer can't represent NaN) — use a float "
+                "request dtype for this chaos run"
+            )
+        # .flat assigns in place on ANY memory layout; reshape(-1) on a
+        # non-contiguous payload would poison a throwaway copy and let
+        # the drill pass vacuously
+        payload.flat[0] = float("nan")
 
 
 class PreemptNotice(Injector):

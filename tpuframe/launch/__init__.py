@@ -41,6 +41,7 @@ from tpuframe.launch.elastic import (
 from tpuframe.launch.remote import (
     RemoteDistributor,
     RemoteLaunchError,
+    all_env_vars,
     ssh_connect,
 )
 from tpuframe.launch.trainer_api import (
@@ -59,6 +60,7 @@ __all__ = [
     "DistributorError",
     "RemoteDistributor",
     "RemoteLaunchError",
+    "all_env_vars",
     "ssh_connect",
     "WorkerLostError",
     "ZeroDistributor",

@@ -73,6 +73,27 @@ def ssh_connect(host: str) -> list[str]:
     return ["ssh", "-o", "BatchMode=yes", host]
 
 
+def all_env_vars() -> tuple[str, ...]:
+    """Every spine's env-knob list, aggregated — THE single registry
+    consumed by remote worker shipping (below) and the doctor.
+
+    Each spine declares its own list next to its knobs
+    (``OBSERVABILITY_ENV_VARS``, ``COMPILE_ENV_VARS``,
+    ``HEALTH_ENV_VARS``, ``SERVE_ENV_VARS``); new spines add themselves
+    HERE, and both consumers pick them up for free — the concrete first
+    step toward the ROADMAP item-5 typed knob registry.  All four source
+    modules are stdlib-only imports (no jax), so this resolves on a
+    wedged-backend doctor run too.
+    """
+    from tpuframe.compile.cache import COMPILE_ENV_VARS
+    from tpuframe.fault.health import HEALTH_ENV_VARS
+    from tpuframe.serve.admission import SERVE_ENV_VARS
+    from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
+
+    return (OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS + HEALTH_ENV_VARS
+            + SERVE_ENV_VARS)
+
+
 class _Worker:
     """One spawned agent: process handle + stdio pump threads + outcome."""
 
@@ -227,18 +248,16 @@ class RemoteDistributor:
         # stdin header alone, and a fleet whose ranks silently ran
         # without telemetry cannot be skew-analyzed after the fact
         # (``python -m tpuframe.track analyze`` needs every rank's log).
-        from tpuframe.compile.cache import COMPILE_ENV_VARS
-        from tpuframe.fault.health import HEALTH_ENV_VARS
-        from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
-
         # compile-cache knobs ride along for the same reason: a worker
         # restarted on the same host (or a new rank joining it) must hit
         # the warm cache the driver configured, not recompile cold.
         # Health-sentinel knobs too: divergence thresholds and rollback
         # perturbation must be fleet-uniform, or ranks disagree on
         # whether a step was bad and the synchronous loop deadlocks on
-        # one rank raising Divergence alone
-        for var in OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS + HEALTH_ENV_VARS:
+        # one rank raising Divergence alone.  Serve knobs likewise: a
+        # serving fleet whose replicas disagree on SLO/shed policy
+        # load-balances incoherently.  all_env_vars() is the one list.
+        for var in all_env_vars():
             if var in os.environ and var not in env:
                 env[var] = os.environ[var]
         env.update(

@@ -1,14 +1,50 @@
-"""Deployment: portable serialized inference artifacts.
+"""Deployment: portable serialized inference artifacts + the serving spine.
 
-The reference's serving story is an in-notebook demo (single-image
-predict after training, `02_cifar_torch_distributor_resnet.py:370-387`);
-tpuframe keeps that (``train.make_predict_fn``) and adds the deployable
-half: :func:`export_model` freezes (model, variables, preprocessing) into
-a version-stable StableHLO artifact via ``jax.export`` that any JAX
-runtime — CPU serving box or TPU — loads and calls without the model
-code, flax, or the checkpoint being present.
+Two halves:
+
+- **Export** (:func:`export_model` / :func:`load_model`): freeze (model,
+  variables, preprocessing) into a version-stable StableHLO artifact via
+  ``jax.export`` that any JAX runtime loads and calls without the model
+  code, flax, or the checkpoint being present.
+- **Serving** (:class:`ServeEngine` / :class:`ServingServer`): a
+  deadline-aware dynamic-batching engine over that artifact — bucketed
+  AOT-precompiled batch shapes, bounded-queue admission control with
+  explicit shed verdicts, door-side poison-input validation, graceful
+  SIGTERM drain, and a watchdog lease on every backend call.  SERVE.md
+  is the runbook.
+
+Exports are lazy (PEP 562): the knob list / admission policy / artifact
+header reader stay importable while the jax backend is wedged — the
+doctor and the remote launcher depend on that.
 """
 
-from tpuframe.serve.export import ExportedModel, export_model, load_model
+_LAZY = {
+    "AdmissionController": "tpuframe.serve.admission",
+    "ExportedModel": "tpuframe.serve.export",
+    "InvalidRequest": "tpuframe.serve.admission",
+    "RequestRejected": "tpuframe.serve.admission",
+    "RequestShed": "tpuframe.serve.admission",
+    "SERVE_ENV_VARS": "tpuframe.serve.admission",
+    "ServeEngine": "tpuframe.serve.engine",
+    "ServeKnobs": "tpuframe.serve.admission",
+    "ServeResult": "tpuframe.serve.engine",
+    "ServingServer": "tpuframe.serve.server",
+    "export_model": "tpuframe.serve.export",
+    "load_model": "tpuframe.serve.export",
+    "read_export_meta": "tpuframe.serve.admission",
+    "validate_payload": "tpuframe.serve.admission",
+}
 
-__all__ = ["ExportedModel", "export_model", "load_model"]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'tpuframe.serve' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

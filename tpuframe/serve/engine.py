@@ -1,0 +1,524 @@
+"""Deadline-aware dynamic-batching inference engine on the existing spines.
+
+The repo's training side survives kills, shrinks, and divergence (PRs
+3/6/7); this is the same robustness discipline applied to the request
+path.  :class:`ServeEngine` turns an exported model (or any jit-able
+callable) into a bounded-latency server component:
+
+- **Bucketed dynamic batching.**  Requests batch into a small closed set
+  of padded bucket shapes (``ServeKnobs.buckets``), every bucket
+  AOT-precompiled at :meth:`start` via ``compile.precompile`` — the
+  armed :class:`~tpuframe.compile.precompile.ShapeGuard` makes any stray
+  runtime shape one loud ``compile/recompile`` event.  Host-side batch
+  assembly reuses :class:`~tpuframe.data.loader.BatchBufferPool` leases
+  (one small pool per bucket; steady-state assembly allocations are
+  zero, and the pool's aliasing guards carry over unchanged).
+- **Deadlines propagated into scheduling.**  Every request carries a
+  deadline (client-set, default the SLO); a request whose deadline
+  expired *in the queue* is shed before it wastes a batch slot on an
+  answer the client already abandoned.
+- **Admission control.**  The bounded queue + explicit verdicts live in
+  :class:`~tpuframe.serve.admission.AdmissionController`; door-side
+  validation (:func:`~tpuframe.serve.admission.validate_payload`)
+  rejects malformed/poison payloads before they can NaN a batch.
+- **Graceful drain.**  ``drain()`` — or a SIGTERM via the process-wide
+  :class:`~tpuframe.fault.preempt.PreemptionWatcher`, polled at batch
+  boundaries — flips admission to reject-new, finishes every in-flight
+  request, flushes telemetry, and stops.  Zero dropped in-flight work.
+- **Watchdog lease.**  Each backend inference call runs under a
+  ``serve/infer`` watchdog guard, so a wedged backend produces an
+  attributed stall report instead of a silent hang.
+- **Isolation.**  A backend error fails only the requests in that batch
+  (``serve/errors``); the loop keeps serving.
+
+Chaos sites (``fault.chaos``): ``serve/submit`` (PoisonRequest corrupts
+the payload upstream of validation), ``serve/enqueue`` (QueueFlood
+floods the queue with synthetic load), ``serve/infer`` (SlowConsumer /
+RaiseAt wedge or fail the backend call) — every degradation path is
+deterministically testable on CPU.
+
+Telemetry: ``serve/latency`` + ``serve/batch_occupancy`` histograms,
+``serve/queue_depth``/``serve/draining`` gauges, admit/shed/reject/
+invalid/error counters, one ``serve/request`` event per served request
+(what ``track analyze`` builds its ``serve_latency`` block from), and a
+rate-limited ``serve/rejected``/``serve/shed`` event stream (first
+occurrence per verdict always logs; steady-state overload counts
+instead of flooding the JSONL log).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from tpuframe.data.loader import BatchBufferPool
+from tpuframe.fault import chaos
+from tpuframe.serve.admission import (
+    AdmissionController,
+    InvalidRequest,
+    RequestRejected,
+    RequestShed,
+    ServeKnobs,
+    validate_payload,
+)
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = ["ServeEngine", "ServeResult"]
+
+
+class ServeResult:
+    """Future-like handle for one submitted request.
+
+    ``result(timeout)`` blocks for the value; a shed request raises
+    :class:`RequestShed`, a backend failure re-raises the batch's error.
+    """
+
+    __slots__ = ("id", "verdict", "latency_s", "_event", "_value", "_error")
+
+    def __init__(self, rid: int):
+        self.id = rid
+        self.verdict: str | None = None
+        self.latency_s: float | None = None
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} not completed in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _complete(self, value, verdict: str, latency_s: float) -> None:
+        self._value = value
+        self.verdict = verdict
+        self.latency_s = latency_s
+        self._event.set()
+
+    def _fail(self, error: BaseException, verdict: str) -> None:
+        self._error = error
+        self.verdict = verdict
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("payload", "res", "t_submit", "deadline", "synthetic")
+
+    def __init__(self, payload, res: ServeResult | None, t_submit: float,
+                 deadline: float, synthetic: bool = False):
+        self.payload = payload
+        self.res = res
+        self.t_submit = t_submit
+        self.deadline = deadline
+        self.synthetic = synthetic
+
+
+class _RateLimitedEvents:
+    """At most one JSONL event per (name, verdict) per ``interval_s`` —
+    overload is precisely when per-occurrence events would bury the log;
+    counters carry the volume, the first event carries the news."""
+
+    def __init__(self, interval_s: float = 1.0):
+        self.interval_s = interval_s
+        self._last: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def emit(self, tele, name: str, **fields) -> None:
+        key = (name, fields.get("verdict"))
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last.get(key, -1e9) < self.interval_s:
+                return
+            self._last[key] = now
+        tele.event(name, **fields)
+
+
+class ServeEngine:
+    """Dynamic-batching engine over an exported model or jit-able callable.
+
+    Args:
+      model: an :class:`~tpuframe.serve.export.ExportedModel` (item
+        shape/dtype come from its meta) or any callable traced by
+        ``jax.jit`` taking one batched array; plain callables must also
+        pass ``item_shape=`` and ``dtype=``.
+      knobs: :class:`ServeKnobs` (default: from env).
+      item_shape / dtype: per-request payload signature (required for
+        plain callables; overrides the export meta when given).
+      preemption: poll the process-wide preemption watcher at batch
+        boundaries and auto-drain on SIGTERM/maintenance notice
+        (default True — the serve loop's graceful-exit contract).
+
+    Lifecycle: ``start()`` AOT-precompiles every bucket and starts the
+    batcher thread; ``submit()`` returns a :class:`ServeResult`;
+    ``drain()`` finishes in-flight work and stops.  Context-managed::
+
+        with ServeEngine(load_model(path)) as eng:
+            out = eng.submit(x).result(timeout=5)
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        knobs: ServeKnobs | None = None,
+        item_shape: tuple | None = None,
+        dtype: Any = None,
+        preemption: bool = True,
+    ):
+        self.knobs = knobs or ServeKnobs.from_env()
+        self.preemption = preemption
+        meta = getattr(model, "meta", None)
+        if item_shape is None and isinstance(meta, dict):
+            item_shape = tuple(meta["input_shape"][1:])
+        if dtype is None and isinstance(meta, dict):
+            dtype = meta["input_dtype"]
+        if item_shape is None or dtype is None:
+            raise ValueError(
+                "item_shape= and dtype= are required when model is not an "
+                "ExportedModel (no meta to derive the request signature from)"
+            )
+        self.item_shape = tuple(int(s) for s in item_shape)
+        self.dtype = np.dtype(dtype)
+        # the request signature is fixed per engine, so the pixel budget
+        # is decidable ONCE, here — a misconfigured engine fails at
+        # construction instead of rejecting 100% of requests at the door
+        n_elems = 1
+        for s in self.item_shape:
+            n_elems *= s
+        if n_elems > self.knobs.max_pixels:
+            raise ValueError(
+                f"request shape {self.item_shape} has {n_elems} elements, "
+                f"over the {self.knobs.max_pixels}-element budget "
+                "(TPUFRAME_SERVE_MAX_PIXELS)"
+            )
+        self._fn = model._exported.call if hasattr(model, "_exported") else model
+        self._jit = None        # built at start()
+        self._compiled: dict[int, Any] = {}
+        self._guard = None
+        self.buckets = tuple(sorted(self.knobs.buckets))
+        self._pools = {
+            b: BatchBufferPool(2) for b in self.buckets
+        }
+        self._admission = AdmissionController(
+            cap=self.knobs.queue_cap, policy=self.knobs.shed_policy
+        )
+        self._rid = itertools.count()
+        self._submitted = 0     # chaos-site step counter (door side)
+        self._batches = 0       # chaos-site step counter (batcher side)
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._started = False
+        self._limited = _RateLimitedEvents()
+        reg = get_telemetry().registry
+        self._c_admitted = reg.counter("serve/admitted")
+        self._c_rejected = reg.counter("serve/rejected")
+        self._c_shed = reg.counter("serve/shed")
+        self._c_invalid = reg.counter("serve/invalid")
+        self._c_served = reg.counter("serve/requests_served")
+        self._c_batches = reg.counter("serve/batches")
+        self._c_errors = reg.counter("serve/errors")
+        self._h_latency = reg.histogram("serve/latency")
+        self._h_occupancy = reg.histogram("serve/batch_occupancy")
+        self._g_draining = reg.gauge("serve/draining")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServeEngine":
+        """AOT-precompile every bucket shape, arm the shape guard, start
+        the batcher thread.  Idempotent."""
+        if self._started:
+            return self
+        import jax
+
+        from tpuframe.compile.precompile import (
+            ShapeGuard,
+            batch_signature,
+            precompile_call,
+        )
+
+        tele = get_telemetry()
+        self._jit = jax.jit(self._fn)
+        self._guard = ShapeGuard()
+        for b in self.buckets:
+            spec = jax.ShapeDtypeStruct((b,) + self.item_shape, self.dtype)
+            self._compiled[b] = precompile_call(
+                self._jit, (spec,), label=f"serve/bucket{b}"
+            )
+            self._guard.expect("serve", batch_signature({"image": spec}))
+        tele.event(
+            "serve/started",
+            buckets=list(self.buckets),
+            slo_ms=self.knobs.slo_ms,
+            queue_cap=self.knobs.queue_cap,
+            shed_policy=self.knobs.shed_policy,
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="tpuframe-serve-batcher", daemon=True
+        )
+        self._started = True
+        self._thread.start()
+        return self
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.drain()
+
+    @property
+    def draining(self) -> bool:
+        return self._admission.draining
+
+    def queue_depth(self) -> int:
+        return self._admission.depth()
+
+    # -- door ----------------------------------------------------------------
+    def submit(self, x: Any, *, deadline_ms: float | None = None) -> ServeResult:
+        """Validate, admit, and enqueue one request.
+
+        Raises :class:`InvalidRequest` (malformed/poison payload) or
+        :class:`RequestRejected` (queue full under reject-new, or
+        draining) synchronously; otherwise returns a
+        :class:`ServeResult` whose ``result()`` yields this request's
+        row of the model output.  Under ``shed-oldest`` an admission may
+        evict the oldest queued request — *that* request's future fails
+        with :class:`RequestShed`.
+        """
+        if not self._started:
+            raise RuntimeError("ServeEngine.start() first")
+        step = self._submitted
+        self._submitted += 1
+        tele = get_telemetry()
+        # poison injection point: upstream of validation, exactly where
+        # a corrupt client payload would enter
+        chaos.maybe_fire("serve/submit", step, payload=x, engine=self)
+        try:
+            validate_payload(
+                x, item_shape=self.item_shape, dtype=self.dtype,
+                max_pixels=self.knobs.max_pixels,
+            )
+        except InvalidRequest as e:
+            self._c_invalid.inc()
+            self._limited.emit(
+                tele, "serve/rejected", verdict="invalid", error=str(e)[:300]
+            )
+            raise
+        chaos.maybe_fire("serve/enqueue", step, engine=self)
+        now = time.monotonic()
+        slo_s = (self.knobs.slo_ms if deadline_ms is None
+                 else float(deadline_ms)) / 1e3
+        res = ServeResult(next(self._rid))
+        req = _Request(x, res, now, now + slo_s)
+        verdict, shed = self._admission.offer(req)
+        if shed is not None:
+            self._shed(shed, "shed-oldest")
+        if verdict != "admitted":
+            self._c_rejected.inc()
+            self._limited.emit(tele, "serve/rejected", verdict=verdict)
+            raise RequestRejected(
+                f"request rejected: {verdict} (queue_cap="
+                f"{self.knobs.queue_cap}, policy={self.knobs.shed_policy})",
+                verdict=verdict,
+            )
+        self._c_admitted.inc()
+        return res
+
+    def flood(self, n: int, *, deadline_ms: float | None = None) -> int:
+        """Enqueue ``n`` synthetic zero requests straight through
+        admission (the :class:`~tpuframe.fault.chaos.QueueFlood`
+        injector's hook — deterministic overload without n client
+        threads).  Returns how many were admitted; their results are
+        discarded."""
+        tele = get_telemetry()
+        now = time.monotonic()
+        slo_s = (self.knobs.slo_ms if deadline_ms is None
+                 else float(deadline_ms)) / 1e3
+        payload = np.zeros(self.item_shape, self.dtype)
+        admitted = 0
+        for _ in range(int(n)):
+            req = _Request(payload, None, now, now + slo_s, synthetic=True)
+            verdict, shed = self._admission.offer(req)
+            if shed is not None:
+                self._shed(shed, "shed-oldest")
+            if verdict == "admitted":
+                admitted += 1
+                self._c_admitted.inc()
+            else:
+                self._c_rejected.inc()
+                self._limited.emit(tele, "serve/rejected", verdict=verdict,
+                                   flood=True)
+        tele.event("serve/flood", n=int(n), admitted=admitted)
+        return admitted
+
+    # -- drain / stop --------------------------------------------------------
+    def drain(self, timeout: float | None = 30.0, *,
+              reason: str = "drain") -> bool:
+        """Graceful exit: reject new requests, finish every in-flight
+        one, flush telemetry.  Returns True when the queue fully
+        drained inside ``timeout``."""
+        if not self._started:
+            return True
+        tele = get_telemetry()
+        if not self._admission.draining:
+            self._g_draining.set(1.0)
+            tele.event("serve/drain", reason=reason,
+                       queue_depth=self._admission.depth())
+            self._admission.start_drain()
+        ok = self._drained.wait(timeout)
+        if ok and self._thread is not None:
+            self._thread.join(timeout=5.0)
+        tele.event(
+            "serve/drained",
+            ok=ok,
+            served=int(self._c_served.value),
+            shed=int(self._c_shed.value),
+            rejected=int(self._c_rejected.value),
+        )
+        return ok
+
+    def stop(self) -> None:
+        """Hard stop (tests/teardown): no new batches after the current
+        one; queued requests are shed, not silently dropped."""
+        self._stop.set()
+        self._admission.start_drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        while True:
+            req = self._admission.pop_nowait()
+            if req is None:
+                break
+            self._shed(req, "shed-stopped")
+
+    # -- internals -----------------------------------------------------------
+    def _shed(self, req: _Request, verdict: str) -> None:
+        self._c_shed.inc()
+        self._limited.emit(get_telemetry(), "serve/shed", verdict=verdict)
+        if req.res is not None:
+            req.res._fail(
+                RequestShed(f"request shed: {verdict}", verdict=verdict),
+                verdict,
+            )
+
+    def _maybe_auto_drain(self) -> None:
+        if not self.preemption or self._admission.draining:
+            return
+        from tpuframe.fault import preempt
+
+        w = preempt.active_watcher()
+        if w is not None and w.requested:
+            get_telemetry().event(
+                "serve/drain", reason=f"preempt:{w.reason}",
+                queue_depth=self._admission.depth(),
+            )
+            self._g_draining.set(1.0)
+            self._admission.start_drain()
+
+    def _gather(self) -> list[_Request] | None:
+        """One batch's worth of live requests (deadline-expired ones
+        shed on the way), or None when idle/drained."""
+        req = self._admission.pop(timeout=0.05)
+        if req is None:
+            return None
+        now = time.monotonic()
+        if now >= req.deadline:
+            self._shed(req, "shed-deadline")
+            return []
+        batch = [req]
+        max_bucket = self.buckets[-1]
+        hold_until = now + self.knobs.batch_wait_ms / 1e3
+        while len(batch) < max_bucket:
+            remaining = hold_until - time.monotonic()
+            nxt = (self._admission.pop_nowait() if remaining <= 0
+                   else self._admission.pop(timeout=min(remaining, 0.005)))
+            if nxt is None:
+                if remaining <= 0:
+                    break
+                continue
+            if time.monotonic() >= nxt.deadline:
+                self._shed(nxt, "shed-deadline")
+                continue
+            batch.append(nxt)
+        return batch
+
+    def _loop(self) -> None:
+        import jax
+
+        from tpuframe.compile.precompile import batch_signature
+
+        tele = get_telemetry()
+        while True:
+            if self._stop.is_set():
+                break  # hard stop: stop() sheds the queued remainder
+            self._maybe_auto_drain()
+            batch = self._gather()
+            if batch is None:
+                if self._admission.draining and self._admission.depth() == 0:
+                    break
+                continue
+            if not batch:
+                continue
+            bidx = self._batches
+            self._batches += 1
+            n = len(batch)
+            bucket = next(b for b in self.buckets if b >= n)
+            try:
+                chaos.maybe_fire("serve/batch", bidx, n=n, bucket=bucket,
+                                 engine=self)
+                pool = self._pools[bucket]
+                lease = pool.acquire(bucket, self.item_shape, self.dtype,
+                                     with_valid=False)
+                for i, r in enumerate(batch):
+                    np.copyto(lease.images[i], r.payload, casting="same_kind")
+                for i in range(n, bucket):  # pad by cycling live payloads
+                    np.copyto(lease.images[i], batch[i % n].payload,
+                              casting="same_kind")
+                sig = batch_signature({"image": lease.images})
+                self._guard.check("serve", sig)
+                # watchdog_s=0 means DISABLED, including any process-wide
+                # default deadline — passing None would fall back to it
+                wd = (tele.guard("serve/infer", self.knobs.watchdog_s)
+                      if self.knobs.watchdog_s > 0 else contextlib.nullcontext())
+                with tele.span("serve/infer", batch=bidx, bucket=bucket, n=n), \
+                        wd:
+                    chaos.maybe_fire("serve/infer", bidx, engine=self)
+                    xd = jax.device_put(lease.images)
+                    compiled = self._compiled.get(bucket)
+                    out = np.asarray(compiled(xd) if compiled is not None
+                                     else self._jit(xd))
+                pool.release(lease, device_arrays=xd)
+            except Exception as e:  # noqa: BLE001 - batch-scoped isolation
+                self._c_errors.inc()
+                tele.event("serve/batch_error", batch=bidx,
+                           error=f"{type(e).__name__}: {e}"[:300])
+                for r in batch:
+                    if r.res is not None:
+                        r.res._fail(e, "error")
+                continue
+            done = time.monotonic()
+            self._h_occupancy.observe(n / bucket)
+            self._c_batches.inc()
+            for i, r in enumerate(batch):
+                lat = done - r.t_submit
+                self._h_latency.observe(lat)
+                self._c_served.inc()
+                tele.event("serve/request", latency_s=round(lat, 6),
+                           batch=bidx, verdict="ok",
+                           **({"synthetic": True} if r.synthetic else {}))
+                if r.res is not None:
+                    r.res._complete(out[i], "ok", lat)
+        self._drained.set()
+
+
+# one import surface for the typed errors callers catch around submit()
+ServeEngine.InvalidRequest = InvalidRequest
+ServeEngine.RequestRejected = RequestRejected
+ServeEngine.RequestShed = RequestShed

@@ -10,6 +10,10 @@ that has neither the training repo nor flax installed.  A checkpoint
 (`tpuframe.ckpt`) needs the model class to rebuild; the exported artifact
 needs only jax.  (For torch serving, `models/interop.export_torch_resnet`
 is the other exit.)
+
+jax is imported lazily: the module (and the header parse it shares with
+the doctor via ``serve.admission.read_export_meta``) must stay usable
+while the backend is wedged.
 """
 
 from __future__ import annotations
@@ -18,22 +22,57 @@ import json
 import os
 from typing import Any, Callable, Sequence
 
-import jax
 import numpy as np
-from jax import export as jax_export
+
+from tpuframe.serve.admission import InvalidRequest, read_export_meta
 
 _MAGIC = "tpuframe-export"
 _VERSION = 1
 
 
 class ExportedModel:
-    """A loaded artifact: ``__call__`` runs inference on numpy/jax arrays."""
+    """A loaded artifact: ``__call__`` runs inference on numpy/jax arrays.
 
-    def __init__(self, exported: jax_export.Exported, meta: dict):
+    Calls are validated against the exported signature first: a wrong
+    dtype or trailing shape raises a ``ValueError`` naming what the
+    artifact expects, instead of surfacing as an opaque XLA shape error
+    deep inside ``exported.call`` (or worse, a silent implicit cast).
+    """
+
+    def __init__(self, exported: Any, meta: dict):
         self._exported = exported
         self.meta = meta
 
-    def __call__(self, x: Any) -> jax.Array:
+    def _validate(self, x: Any) -> None:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        want_trailing = tuple(self.meta["input_shape"][1:])
+        want_dtype = self.meta["input_dtype"]
+        lead = "b" if self.meta.get("batch_polymorphic", True) \
+            else self.meta["input_shape"][0]
+        expected = f"({lead}, {', '.join(map(str, want_trailing))}) {want_dtype}"
+        if shape is None or dtype is None:
+            raise ValueError(
+                f"expected an array of shape {expected}; got "
+                f"{type(x).__name__}"
+            )
+        if len(shape) != 1 + len(want_trailing) \
+                or tuple(shape[1:]) != want_trailing \
+                or (not self.meta.get("batch_polymorphic", True)
+                    and int(shape[0]) != int(self.meta["input_shape"][0])):
+            raise ValueError(
+                f"input shape {tuple(shape)} does not match the exported "
+                f"signature {expected} (model={self.meta.get('model')})"
+            )
+        if str(dtype) != want_dtype:
+            raise ValueError(
+                f"input dtype {dtype} does not match the exported "
+                f"signature {expected} — cast before calling "
+                f"(model={self.meta.get('model')})"
+            )
+
+    def __call__(self, x: Any) -> Any:
+        self._validate(x)
         return self._exported.call(x)
 
     @property
@@ -44,7 +83,7 @@ class ExportedModel:
 def export_model(
     model: Any,
     variables: Any,
-    sample_input: np.ndarray | jax.Array,
+    sample_input: "np.ndarray | Any",
     path: str | os.PathLike,
     *,
     preprocess: Callable | None = None,
@@ -72,6 +111,9 @@ def export_model(
     Returns the written path.  The artifact is self-contained: load it
     with :func:`load_model` anywhere jax runs.
     """
+    import jax
+    from jax import export as jax_export
+
     kwargs = dict(apply_kwargs or {})
     if "train" not in kwargs:
         import inspect
@@ -129,34 +171,38 @@ def export_model(
     return path
 
 
-_MAX_HEADER = 1 << 20  # far above any real meta; rejects garbage lengths
-
-
 def load_model(path: str | os.PathLike) -> ExportedModel:
     """Load an :func:`export_model` artifact; no model code needed.
 
-    Any non-artifact file raises ``ValueError`` — the first 8 bytes of
-    arbitrary binaries decode to arbitrary "header lengths", so the
-    length is bounds-checked and header parse failures are wrapped
-    rather than surfacing as MemoryError/UnicodeDecodeError.
+    Any non-artifact file raises ``ValueError`` (the bounds-checked
+    header parse is shared with the doctor:
+    :func:`tpuframe.serve.admission.read_export_meta`).  The meta
+    version is checked with direction-aware messages: a NEWER blob says
+    "upgrade tpuframe", not just "unsupported".
     """
+    from jax import export as jax_export
+
     path = os.fspath(path)
-    size = os.path.getsize(path)
-    with open(path, "rb") as f:
-        header_len = int.from_bytes(f.read(8), "little")
-        if not 2 <= header_len <= min(_MAX_HEADER, size):
-            raise ValueError(f"{path} is not a tpuframe export artifact")
-        try:
-            meta = json.loads(f.read(header_len).decode("utf-8"))
-        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+    meta = read_export_meta(path)
+    version = meta.pop("version", None)
+    offset = meta.pop("_blob_offset")
+    if version != _VERSION:
+        if isinstance(version, int) and version > _VERSION:
             raise ValueError(
-                f"{path} is not a tpuframe export artifact"
-            ) from e
-        if not isinstance(meta, dict) or meta.get("magic") != _MAGIC:
-            raise ValueError(f"{path} is not a tpuframe export artifact")
-        if meta.get("version") != _VERSION:
-            raise ValueError(
-                f"unsupported artifact version {meta.get('version')}"
+                f"{path} was written by a newer tpuframe (artifact "
+                f"version {version} > supported {_VERSION}) — upgrade "
+                "tpuframe on this serving host to load it"
             )
+        raise ValueError(
+            f"unsupported artifact version {version}"
+        )
+    meta["version"] = version
+    with open(path, "rb") as f:
+        f.seek(offset)
         blob = f.read()
     return ExportedModel(jax_export.deserialize(blob), meta)
+
+
+# re-exported for callers that validated payloads at the engine door
+# before reaching the model (one exception type across the serve stack)
+__all__ = ["ExportedModel", "InvalidRequest", "export_model", "load_model"]

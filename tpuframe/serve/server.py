@@ -1,0 +1,167 @@
+"""HTTP front for the serve engine: predict + health + metrics.
+
+A thin stdlib ``ThreadingHTTPServer`` over :class:`ServeEngine` (the
+same shape as ``track.http_store.MetricsServer`` — no framework deps on
+a serving box).  Endpoints:
+
+- ``POST /predict`` — body is an ``.npy`` blob (``np.save`` of one
+  request payload; content-type anything).  Optional header
+  ``X-Deadline-Ms`` propagates the client deadline into scheduling.
+  Responses carry the admission verdict as an HTTP status: 200 served
+  (JSON ``{"output": [...], "latency_ms": ...}``), 400 invalid payload,
+  429 shed/rejected under load (clients should back off), 503 draining
+  (the replica is going away — retry elsewhere).
+- ``GET /healthz`` — ``{"status": "ok"|"draining", "queue_depth": N}``;
+  a load balancer drops a draining replica from rotation on this.
+- ``GET /metrics`` — Prometheus text from the process registry (the
+  serve histograms/gauges/counters ride the existing telemetry spine).
+
+``run_forever()`` installs the process-wide preemption watcher, so a
+platform SIGTERM follows the graceful ladder: stop admitting, finish
+in-flight requests, flush telemetry, exit 0.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from typing import Any
+
+from tpuframe.serve.admission import InvalidRequest, RequestRejected, RequestShed
+
+__all__ = ["ServingServer"]
+
+
+class ServingServer:
+    """Serve ``engine`` over HTTP from a daemon thread.
+
+    ``port=0`` picks a free port; read it back from ``.port``/``.url``.
+    """
+
+    def __init__(self, engine: Any, *, host: str = "127.0.0.1", port: int = 0,
+                 result_timeout_s: float = 60.0):
+        import numpy as np
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from tpuframe.track.telemetry import get_telemetry
+
+        self.engine = engine
+        self.result_timeout_s = float(result_timeout_s)
+        # one request payload, exactly: item bytes + .npy header slack
+        item = np.zeros(engine.item_shape, engine.dtype)
+        self.max_body_bytes = int(item.nbytes) + 4096
+        registry = get_telemetry().registry
+        server_self = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    body = registry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    eng = server_self.engine
+                    self._send(200, {
+                        "status": "draining" if eng.draining else "ok",
+                        "queue_depth": eng.queue_depth(),
+                    })
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] != "/predict":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                # transport-level size door: the body is bounded by the
+                # engine's fixed request signature BEFORE any read/parse
+                # allocates it — a declared 16 GB Content-Length must
+                # not OOM the box on its way to validate_payload
+                if not 0 < n <= server_self.max_body_bytes:
+                    self._send(413, {
+                        "error": f"body must be 1..{server_self.max_body_bytes}"
+                                 " bytes (one .npy request payload)",
+                        "verdict": "invalid",
+                    })
+                    return
+                raw = self.rfile.read(n)
+                try:
+                    payload = np.load(io.BytesIO(raw), allow_pickle=False)
+                except Exception:
+                    self._send(400, {"error": "body must be an .npy blob "
+                                              "(np.save of one payload)"})
+                    return
+                deadline = self.headers.get("X-Deadline-Ms")
+                try:
+                    deadline_ms = float(deadline) if deadline else None
+                except ValueError:
+                    deadline_ms = None
+                try:
+                    res = server_self.engine.submit(
+                        payload, deadline_ms=deadline_ms
+                    )
+                    out = res.result(timeout=server_self.result_timeout_s)
+                except InvalidRequest as e:
+                    self._send(400, {"error": str(e), "verdict": "invalid"})
+                except RequestRejected as e:
+                    code = 503 if e.verdict == "rejected-draining" else 429
+                    self._send(code, {"error": str(e), "verdict": e.verdict})
+                except RequestShed as e:
+                    self._send(429, {"error": str(e), "verdict": e.verdict})
+                except TimeoutError as e:
+                    self._send(504, {"error": str(e), "verdict": "timeout"})
+                else:
+                    self._send(200, {
+                        "output": np.asarray(out).tolist(),
+                        "latency_ms": round((res.latency_s or 0.0) * 1e3, 3),
+                        "verdict": res.verdict,
+                    })
+
+            def log_message(self, *args):  # requests must not spam stderr
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpuframe-serve-http", daemon=True,
+        )
+        self._thread.start()
+
+    def run_forever(self, poll_s: float = 0.25) -> None:
+        """Block until a preemption notice, then drain gracefully.
+
+        Installs the process-wide watcher (SIGTERM); on notice: the
+        engine drains (reject new, finish in-flight, flush telemetry)
+        and the HTTP server shuts down.
+        """
+        from tpuframe.fault import preempt
+
+        watcher = preempt.install()
+        while not watcher.wait(poll_s):
+            pass
+        self.engine.drain(reason=f"preempt:{watcher.reason}")
+        self.close()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)

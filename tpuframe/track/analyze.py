@@ -515,6 +515,25 @@ def skew_report(ranks: Sequence[RankLog], *,
             "p95": round(_pctl(durs, 0.95), 6),
             "p99": round(_pctl(durs, 0.99), 6),
         }
+    # serve-path latency: present only when the run served requests
+    # (ServeEngine emits one serve/request event per served request).
+    # Shaped like step_time so baseline_diff gates a p99 latency
+    # regression with the same exit-3 discipline as a step-time one.
+    serve_lats = sorted(
+        float(rec["latency_s"])
+        for rl in ranks for rec in rl.events
+        if rec.get("name") == "serve/request"
+        and isinstance(rec.get("latency_s"), (int, float))
+    )
+    serve_latency = None
+    if serve_lats:
+        serve_latency = {
+            "count": len(serve_lats),
+            "mean": round(sum(serve_lats) / len(serve_lats), 6),
+            "p50": round(_pctl(serve_lats, 0.50), 6),
+            "p95": round(_pctl(serve_lats, 0.95), 6),
+            "p99": round(_pctl(serve_lats, 0.99), 6),
+        }
     worst = max(excess, key=lambda r: excess[r]) if excess else None
     # measured compile wall: the warmup skip exists because the first
     # step carries the compile — report WHAT it carried instead of
@@ -567,6 +586,7 @@ def skew_report(ranks: Sequence[RankLog], *,
         } if ttfs_vals else None,
         "health": health_info,
         "straggler_factor": straggler_factor,
+        "serve_latency": serve_latency,  # request path (baseline diffs)
         "step_time": step_time,          # dispatch-only (baseline diffs)
         "step_wall": {                   # boundary-to-boundary
             "p50": round(_pctl(walls, 0.50), 6) if walls else None,
@@ -616,7 +636,10 @@ def baseline_diff(report: dict, baseline: str, *,
     Records carrying a ``time_to_first_step`` block (``bench_compile.py``
     commits one) diff the same way against the report's measured
     time-to-first-step — a compile-time regression gates exactly like a
-    step-time regression (exit 3).  ``backend`` filters the baselines
+    step-time regression (exit 3).  Records carrying a ``serve_latency``
+    block with ``p99`` (``bench_serve.py`` commits one) diff against the
+    report's serve-path latency distribution: a p99 latency regression
+    on the request path gates the same way.  ``backend`` filters the baselines
     compared (``"cpu"``/``"tpu"``): without it a CPU run diffed against
     a results dir that also holds TPU records would read ~10x "slower"
     and trip the regression exit code spuriously — pass the backend the
@@ -629,6 +652,7 @@ def baseline_diff(report: dict, baseline: str, *,
         paths = sorted(glob.glob(os.path.join(baseline, "*.json")))
     cur = report.get("step_time") or {}
     cur_ttfs = (report.get("time_to_first_step") or {}).get("s")
+    cur_serve = (report.get("serve_latency") or {}).get("p99")
     out: dict = {"threshold": threshold, "backend": backend,
                  "baselines": [], "regressions": []}
     for p in paths:
@@ -643,7 +667,9 @@ def baseline_diff(report: dict, baseline: str, *,
         st = st if isinstance(st, dict) and st.get("p50") else None
         tt = rec.get("time_to_first_step")
         tt = tt if isinstance(tt, dict) and tt.get("s") else None
-        if st is None and tt is None:
+        sv = rec.get("serve_latency")
+        sv = sv if isinstance(sv, dict) and sv.get("p99") else None
+        if st is None and tt is None and sv is None:
             continue
         if backend and rec.get("backend") and rec["backend"] != backend:
             continue
@@ -659,9 +685,16 @@ def baseline_diff(report: dict, baseline: str, *,
             entry["baseline_ttfs_s"] = tt["s"]
             entry["current_ttfs_s"] = cur_ttfs
             entry["ratio_ttfs"] = round(cur_ttfs / tt["s"], 4)
+        if sv is not None and cur_serve:
+            entry["baseline_serve_p99_s"] = sv["p99"]
+            entry["current_serve_p99_s"] = cur_serve
+            entry["ratio_serve_p99"] = round(cur_serve / sv["p99"], 4)
         out["baselines"].append(entry)
         if (entry.get("ratio_p50") and entry["ratio_p50"] > threshold) or (
             entry.get("ratio_ttfs") and entry["ratio_ttfs"] > threshold
+        ) or (
+            entry.get("ratio_serve_p99")
+            and entry["ratio_serve_p99"] > threshold
         ):
             out["regressions"].append(entry)
     return out
@@ -708,6 +741,13 @@ def format_report(report: dict, diff: dict | None = None, *,
             f"  step time (dispatch): p50={st['p50'] * 1e3:.1f}ms "
             f"p95={st['p95'] * 1e3:.1f}ms mean={st['mean'] * 1e3:.1f}ms "
             f"over {st['count']} rank-steps"
+        )
+    sv = report.get("serve_latency") or {}
+    if sv:
+        lines.append(
+            f"  serve latency: p50={sv['p50'] * 1e3:.1f}ms "
+            f"p95={sv['p95'] * 1e3:.1f}ms p99={sv['p99'] * 1e3:.1f}ms "
+            f"over {sv['count']} served request(s)"
         )
     lines.append(
         f"  time lost to stragglers: {report['straggler_lost_s']:.3f}s "
@@ -770,6 +810,12 @@ def format_report(report: dict, diff: dict | None = None, *,
                 parts.append(
                     f"ttfs {b['baseline_ttfs_s']:.3f}s -> "
                     f"{b['current_ttfs_s']:.3f}s (x{b['ratio_ttfs']:.2f})"
+                )
+            if b.get("ratio_serve_p99") is not None:
+                parts.append(
+                    f"serve_p99 {b['baseline_serve_p99_s'] * 1e3:.1f}ms -> "
+                    f"{b['current_serve_p99_s'] * 1e3:.1f}ms "
+                    f"(x{b['ratio_serve_p99']:.2f})"
                 )
             lines.append(
                 f"    vs {b['file']} [{b.get('backend')}]: "
