@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Invariant-linter self-benchmark: the pass prices itself.
+
+The linter runs in tier-1 and inside every doctor report, so its own
+wall time is a budget like the analyzer's (`bench_analyze.py`): this
+rung runs the full pass over the real tree and commits wall-time +
+files/rules scanned to `benchmarks/results/lint_selftest_cpu.json`, so
+a future rule that accidentally goes quadratic over the repo shows up
+as a perf regression, not as a mysteriously slow test suite.
+
+Stdlib + tpuframe.lint only — no jax import; the record's `backend` is
+always `host` (the pass never touches an accelerator), so it can never
+collide with the capture ladder's on-chip stamping.
+
+Usage: python benchmarks/bench_lint.py [--repeats N] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repeats", type=int, default=5,
+                    help="timed full-pass repetitions (median reported)")
+    ap.add_argument("--out", default=None,
+                    help="also write the record to this path")
+    args = ap.parse_args()
+
+    from tpuframe.lint import run_lint
+
+    # one warmup (imports, first tokenize) then timed passes
+    result = run_lint()
+    walls = []
+    for _ in range(max(1, args.repeats)):
+        t0 = time.perf_counter()
+        result = run_lint()
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    median = walls[len(walls) // 2]
+
+    rec = {
+        "metric": "lint_selftest",
+        "value": round(result.files_scanned / max(median, 1e-9), 1),
+        "unit": "files fully linted per second (parse + 5 rule families "
+                "+ doc cross-check, median of repeats)",
+        "backend": "host",
+        "lint_wall_s": round(median, 4),
+        "lint_wall_s_all": [round(w, 4) for w in walls],
+        "files_scanned": result.files_scanned,
+        "rules_run": result.rules_run,
+        "findings": len(result.findings),
+        "suppressed": result.suppressed_count,
+        "python": sys.version.split()[0],
+    }
+    out = json.dumps(rec)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    # a dirty tree is a failed selftest: the bench doubles as the gate
+    return 0 if rec["findings"] == 0 else 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -166,6 +166,12 @@ run bench_fault_divergence.json 300 python benchmarks/bench_fault.py --divergenc
 # cheap, so it rides with the fault rung above the long tail
 run analyze_selftest.json      300  python benchmarks/bench_analyze.py
 
+# invariant-linter rung: the static pass prices itself (and doubles as
+# the contract gate — a dirty tree exits 3 and the stale artifact is
+# kept).  Host-side work, never on-chip; rides here because it is cheap
+# and the doctor/tier-1 budget depends on it staying that way
+run lint_selftest.json         120  python benchmarks/bench_lint.py
+
 # serving rung: closed-loop throughput-vs-latency sweep + the seeded
 # QueueFlood overload run over the real ServeEngine (bucketed dynamic
 # batching, AOT-precompiled shapes) — on the TPU host this prices the

@@ -1,0 +1,3 @@
+"""Clean fixture package: every lint contract holds."""
+
+# tpuframe-lint: stdlib-only

@@ -1,0 +1,15 @@
+"""Mini chaos registry."""
+
+# tpuframe-lint: stdlib-only
+
+CHAOS_SITES = {
+    "loader": "step loop, before pulling the next batch",
+    "ckpt/save": "before the checkpoint write",
+}
+
+_ACTIVE = None
+
+
+def maybe_fire(site_name, step=None, **ctx):
+    if _ACTIVE is not None:
+        _ACTIVE.maybe_fire(site_name, step, **ctx)

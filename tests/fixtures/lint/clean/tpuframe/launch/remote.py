@@ -1,0 +1,11 @@
+"""Mini launcher: the aggregated knob registry."""
+
+LAUNCH_CONTRACT_ENV_VARS = (  # tpuframe-lint: not-shipped
+    "TPUFRAME_PROCESS_ID",
+)
+
+
+def all_env_vars():
+    from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
+
+    return OBSERVABILITY_ENV_VARS

@@ -1,0 +1,1 @@
+# tpuframe-lint: stdlib-only

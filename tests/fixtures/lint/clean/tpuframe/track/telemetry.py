@@ -1,0 +1,44 @@
+"""Mini telemetry spine (stdlib-only contract holds)."""
+
+# tpuframe-lint: stdlib-only
+
+import os
+
+OBSERVABILITY_ENV_VARS = (
+    "TPUFRAME_TELEMETRY_DIR",
+)
+
+
+def telemetry_dir():
+    return os.environ.get("TPUFRAME_TELEMETRY_DIR", "")
+
+
+def env_rank():
+    return int(os.environ.get("TPUFRAME_PROCESS_ID", "0"))
+
+
+class _Registry:
+    def counter(self, name):
+        return self
+
+    def inc(self):
+        pass
+
+
+class _Telemetry:
+    registry = _Registry()
+
+    def span(self, name, **attrs):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def event(self, name, **fields):
+        pass
+
+
+_TELE = _Telemetry()
+
+
+def get_telemetry():
+    return _TELE

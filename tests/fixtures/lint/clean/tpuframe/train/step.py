@@ -1,0 +1,33 @@
+"""Mini hot loop: clean under every HP rule."""
+
+import jax
+import jax.numpy as jnp
+
+from tpuframe.fault import chaos
+from tpuframe.track.telemetry import get_telemetry
+
+
+def make_train_step():
+    def step(state, batch):
+        # static-attribute branching is fine under trace
+        if batch["x"].ndim == 3:
+            x = batch["x"][None]
+        else:
+            x = batch["x"]
+        loss = jnp.mean(x)
+        return state, {"loss": loss}
+
+    # donating the state position is the sanctioned pattern
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def run_epoch(loader, step_fn, state):
+    tele = get_telemetry()
+    for i, batch in enumerate(loader):
+        chaos.maybe_fire("loader", step=i)
+        state, metrics = step_fn(state, batch)
+        with tele.span("train/host_block"):
+            # spanned sync: measured, therefore allowed
+            jax.block_until_ready(metrics)
+        chaos.maybe_fire("ckpt/save", step=i)
+    return state

@@ -1,0 +1,3 @@
+"""Dirty fixture package: every rule family has a violation."""
+
+# tpuframe-lint: stdlib-only

@@ -1,0 +1,12 @@
+"""Claims stdlib-only, breaks it both ways."""
+
+# tpuframe-lint: stdlib-only
+
+import os  # fine
+import numpy  # JF001: heavy import in a marked module
+
+from tpuframe.heavy import helper  # JF002: unmarked dependency
+
+
+def use():
+    return numpy.zeros(int(os.environ.get("N", "1"))), helper()

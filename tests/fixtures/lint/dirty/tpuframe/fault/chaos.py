@@ -1,0 +1,8 @@
+CHAOS_SITES = {
+    "declared_unfired": "no call site fires this (CS002)",
+    "undocumented_site": "fired but absent from FAULT.md (CS003)",
+}
+
+
+def maybe_fire(site_name, step=None, **ctx):
+    pass

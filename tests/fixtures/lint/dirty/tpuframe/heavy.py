@@ -1,0 +1,7 @@
+"""Unmarked module with a heavy import (a JF002 target)."""
+
+import numpy as np  # noqa: F401
+
+
+def helper():
+    return np.zeros(1)

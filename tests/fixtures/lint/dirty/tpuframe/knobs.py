@@ -1,0 +1,22 @@
+"""Knob-accounting violations."""
+
+import os
+
+# KN004: shipped list not aggregated by all_env_vars();
+# KN002: TPUFRAME_DUP also declared in B_ENV_VARS;
+# KN003: TPUFRAME_DEAD is never read;
+# KN005: none of these are documented anywhere
+A_ENV_VARS = (
+    "TPUFRAME_DUP",
+    "TPUFRAME_DEAD",
+)
+
+B_ENV_VARS = (
+    "TPUFRAME_DUP",
+)
+
+
+def reads():
+    orphan = os.environ.get("TPUFRAME_ORPHAN")  # KN001: undeclared
+    waived = os.environ.get("TPUFRAME_WAIVED")  # tpuframe-lint: disable=KN001
+    return orphan, waived, os.environ.get("TPUFRAME_DUP", "x")

@@ -1,0 +1,4 @@
+def all_env_vars():
+    from tpuframe.knobs import B_ENV_VARS
+
+    return B_ENV_VARS

@@ -1,0 +1,31 @@
+"""Hot-path violations: HP001/HP002/HP003, TS001, CS001."""
+
+import jax
+import jax.numpy as jnp
+
+from tpuframe.fault import chaos
+from tpuframe.track.telemetry import get_telemetry
+
+
+def make_train_step():
+    def step(state, batch):
+        loss = jnp.mean(batch["x"])
+        if loss > 3.0:  # HP002: python branch on a traced value
+            loss = loss * 0.5
+        return state, {"loss": loss}
+
+    # HP003: donating the batch position (possibly pool-aliased)
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def run_epoch(loader, step_fn, state):
+    tele = get_telemetry()
+    for i, batch in enumerate(loader):
+        chaos.maybe_fire("rogue", step=i)  # CS001: undeclared site
+        chaos.maybe_fire("undocumented_site", step=i)
+        state, metrics = step_fn(state, batch)
+        # HP001: un-spanned device->host sync on the hot path
+        jax.block_until_ready(metrics)
+        # TS001: emitted but undocumented
+        tele.event("train/mystery", batch=i)
+    return state

@@ -18,6 +18,8 @@ stack (TorchDistributor / DeepSpeed / Composer / Accelerate / Ray Train):
 - ``tpuframe.serve``    — portable StableHLO inference artifacts (jax.export)
 """
 
+# tpuframe-lint: stdlib-only
+
 __version__ = "0.3.0"  # single source: pyproject reads this via setuptools dynamic
 
 _SUBMODULES = (

@@ -12,38 +12,43 @@ TPU-native replacement for the reference's three checkpoint styles
 - Ray's metrics-bundled ``Checkpoint.from_directory``
   (`/root/reference/05_ray/01_fashion_mnist_pytorch_ray.ipynb:cell-6,cell-9`)
   -> metrics/meta JSON saved inside every checkpoint step.
+
+Exports resolve lazily (PEP 562): the stdlib directory readers and
+quarantine/rollback surgery (``ckpt.meta`` — committed/healthy steps,
+topology manifests, torn-step quarantine) must stay importable without
+dragging in orbax/jax, so the doctor and the fault supervisor can
+validate checkpoints against a wedged backend.
 """
 
-from tpuframe.ckpt.checkpoint import (
-    Checkpointer,
-    best_checkpoint_path,
-    healthy_steps,
-    is_committed,
-    latest_healthy_step,
-    latest_step,
-    load_pytree,
-    quarantine_torn_steps,
-    read_health,
-    read_manifest,
-    rollback_to_last_healthy,
-    save_pytree,
-    topology_manifest,
-    valid_steps,
-)
+# tpuframe-lint: stdlib-only
 
-__all__ = [
-    "Checkpointer",
-    "best_checkpoint_path",
-    "healthy_steps",
-    "is_committed",
-    "latest_healthy_step",
-    "latest_step",
-    "load_pytree",
-    "quarantine_torn_steps",
-    "read_health",
-    "read_manifest",
-    "rollback_to_last_healthy",
-    "save_pytree",
-    "topology_manifest",
-    "valid_steps",
-]
+_LAZY = {
+    "Checkpointer": "tpuframe.ckpt.checkpoint",
+    "best_checkpoint_path": "tpuframe.ckpt.checkpoint",
+    "healthy_steps": "tpuframe.ckpt.meta",
+    "is_committed": "tpuframe.ckpt.meta",
+    "latest_healthy_step": "tpuframe.ckpt.meta",
+    "latest_step": "tpuframe.ckpt.meta",
+    "load_pytree": "tpuframe.ckpt.checkpoint",
+    "quarantine_torn_steps": "tpuframe.ckpt.meta",
+    "read_health": "tpuframe.ckpt.meta",
+    "read_manifest": "tpuframe.ckpt.meta",
+    "rollback_to_last_healthy": "tpuframe.ckpt.meta",
+    "save_pytree": "tpuframe.ckpt.checkpoint",
+    "topology_manifest": "tpuframe.ckpt.checkpoint",
+    "valid_steps": "tpuframe.ckpt.meta",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'tpuframe.ckpt' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

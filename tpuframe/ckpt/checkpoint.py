@@ -27,7 +27,6 @@ to unwrap here, TrainState is already the canonical pytree.
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Any, Mapping
@@ -40,14 +39,25 @@ from tpuframe.fault import chaos
 from tpuframe.fault.health import _env_int
 from tpuframe.track.telemetry import get_telemetry
 
-_DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
+# the stdlib half — directory reads, commit/health validation, quarantine
+# and rollback filesystem surgery — lives in ckpt.meta (doctor/supervisor
+# safe against a wedged backend); re-exported here for compatibility
+from tpuframe.ckpt.meta import (  # noqa: F401  (re-exports)
+    COMMIT_MARKERS,
+    _quarantine_move,
+    healthy_steps,
+    is_committed,
+    is_healthy,
+    latest_healthy_step,
+    latest_step,
+    quarantine_torn_steps,
+    read_health,
+    read_manifest,
+    rollback_to_last_healthy,
+    valid_steps,
+)
 
-#: Files whose presence marks a step directory as *committed* — orbax
-#: writes one as the atomic last act of a save (`_CHECKPOINT_METADATA`
-#: since 0.5; `commit_success.txt` on non-atomic-rename filesystems like
-#: GCS).  A digit-named dir without one is torn: a save that died between
-#: data write and commit.
-COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+_DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
 
 
 def _state_data(state: Any) -> dict:
@@ -55,90 +65,6 @@ def _state_data(state: Any) -> dict:
     if isinstance(state, Mapping):
         return dict(state)
     return {f: getattr(state, f) for f in _DATA_FIELDS}
-
-
-def is_committed(step_dir: str | os.PathLike) -> bool:
-    """True iff ``step_dir`` carries a commit marker (a finished save)."""
-    return any(
-        os.path.exists(os.path.join(os.fspath(step_dir), m))
-        for m in COMMIT_MARKERS
-    )
-
-
-def valid_steps(directory: str | os.PathLike) -> list[int]:
-    """Sorted steps under ``directory`` whose saves actually committed.
-
-    Torn dirs (kill between data write and commit) and orbax's in-flight
-    ``*.orbax-checkpoint-tmp-*`` dirs are excluded — resuming from either
-    crash-loops into corrupt state.
-    """
-    try:
-        entries = os.listdir(directory)
-    except (FileNotFoundError, NotADirectoryError):
-        return []
-    return sorted(
-        int(e)
-        for e in entries
-        if e.isdigit() and is_committed(os.path.join(os.fspath(directory), e))
-    )
-
-
-def latest_step(directory: str | os.PathLike) -> int | None:
-    """Highest *committed* step dir under ``directory`` (None if empty or
-    missing).  Counting any digit-named dir — including torn/in-flight
-    saves — would point auto-resume at unreadable state."""
-    steps = valid_steps(directory)
-    return steps[-1] if steps else None
-
-
-def _quarantine_move(directory: str, entry: str) -> str:
-    """Move ``<directory>/<entry>`` into ``<directory>/_quarantine/``
-    (collision-suffixed — a step can be quarantined twice across
-    restarts).  Moved aside, never deleted: quarantined state is
-    evidence and may still be salvageable by hand."""
-    src = os.path.join(directory, entry)
-    qdir = os.path.join(directory, "_quarantine")
-    os.makedirs(qdir, exist_ok=True)
-    dst = os.path.join(qdir, entry)
-    n = 0
-    while os.path.exists(dst):
-        n += 1
-        dst = os.path.join(qdir, f"{entry}.{n}")
-    os.rename(src, dst)
-    return dst
-
-
-def quarantine_torn_steps(directory: str | os.PathLike) -> list[str]:
-    """Move torn step dirs into ``<directory>/_quarantine/`` (the
-    supervisor's pre-resume validation).  Moved aside, never deleted:
-    torn state is *evidence* (which leaves tore, how far the write got)
-    and partially-written arrays may still be salvageable by hand.
-    Returns the quarantined paths.  In-flight ``*-tmp-*`` dirs are left
-    alone.  On atomic-rename filesystems this can never race a live
-    async save: orbax stages the whole step in ``<step>.orbax-…-tmp-*``
-    and the digit dir only appears together with its commit marker
-    (measured on orbax 0.7) — a digit dir without one is genuinely torn.
-    On non-atomic backends (GCS-style, where ``commit_success.txt``
-    exists for this reason) avoid running validation concurrently with a
-    live async save.  Tmp dirs an interrupted save leaves behind are
-    garbage-collected by orbax itself on the next manager construction.
-    """
-    directory = os.fspath(directory)
-    try:
-        entries = os.listdir(directory)
-    except (FileNotFoundError, NotADirectoryError):
-        return []
-    moved: list[str] = []
-    tele = get_telemetry()
-    for e in entries:
-        src = os.path.join(directory, e)
-        if not (e.isdigit() and os.path.isdir(src)) or is_committed(src):
-            continue
-        dst = _quarantine_move(directory, e)
-        moved.append(dst)
-        tele.registry.counter("fault/quarantined_steps").inc()
-        tele.event("fault/quarantine", step=int(e), src=src, dst=dst)
-    return moved
 
 
 # -- topology manifests -------------------------------------------------------
@@ -178,98 +104,6 @@ def topology_manifest(state: Any, plan: Any = None) -> dict | None:
         "zero_stage": getattr(plan, "zero_stage", None),
         "leaves": leaves,
     }
-
-
-def _read_meta_doc(directory: str | os.PathLike, step: int | None) -> dict | None:
-    """The raw meta JSON doc of ``step`` (default: latest committed),
-    read straight off disk — stdlib-only, doctor-safe against a wedged
-    backend."""
-    if step is None:
-        step = latest_step(directory)
-    if step is None:
-        return None
-    path = os.path.join(os.fspath(directory), str(step), "meta", "metadata")
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-    except (FileNotFoundError, NotADirectoryError, IsADirectoryError, ValueError):
-        return None
-    return doc if isinstance(doc, dict) else None
-
-
-def read_manifest(directory: str | os.PathLike, step: int | None = None) -> dict | None:
-    """The topology manifest of ``step`` (default: latest committed), read
-    straight off the on-disk meta JSON — stdlib-only, so the doctor can
-    print it without touching orbax or a possibly-wedged backend.  None
-    for pre-manifest checkpoints or when no committed step exists."""
-    doc = _read_meta_doc(directory, step)
-    return doc.get("topology") if doc else None
-
-
-# -- health records ------------------------------------------------------------
-
-
-def read_health(directory: str | os.PathLike, step: int | None = None) -> dict | None:
-    """The training-health stamp of ``step`` (default: latest committed)
-    — what the Trainer's sentinel wrote next to the topology manifest
-    (loss EWMA, grad norm, bad-step count, ``healthy`` verdict).
-    Stdlib-only like :func:`read_manifest`; None for pre-sentinel
-    checkpoints or when no committed step exists."""
-    doc = _read_meta_doc(directory, step)
-    return doc.get("health") if doc else None
-
-
-def is_healthy(directory: str | os.PathLike, step: int) -> bool:
-    """True unless the step's health stamp explicitly says unhealthy —
-    pre-sentinel checkpoints (no stamp) count healthy, so rollback never
-    strands a run on old-format history."""
-    stamp = read_health(directory, step)
-    return bool((stamp or {}).get("healthy", True))
-
-
-def healthy_steps(directory: str | os.PathLike) -> list[int]:
-    """Committed steps whose health stamp is absent-or-healthy."""
-    return [s for s in valid_steps(directory) if is_healthy(directory, s)]
-
-
-def latest_healthy_step(directory: str | os.PathLike) -> int | None:
-    """Newest committed step rollback may land on (None when every
-    committed step is stamped unhealthy, or none exist)."""
-    steps = healthy_steps(directory)
-    return steps[-1] if steps else None
-
-
-def rollback_to_last_healthy(directory: str | os.PathLike) -> dict:
-    """Divergence rollback: quarantine every committed step NEWER than
-    the newest *healthy* one, so plain auto-resume lands on known-good
-    state instead of the newest (possibly poisoned) save.
-
-    Steps are moved into ``<directory>/_quarantine/`` like torn steps —
-    evidence, never deleted.  When no healthy step exists, every
-    unhealthy-stamped step is quarantined (a fresh start beats resuming
-    into a divergence).  Emits one loud ``fault/rollback`` event +
-    ``fault/rollbacks`` counter when anything moved; a directory already
-    at its healthy frontier is a silent no-op.  Returns
-    ``{"to_step": int | None, "quarantined": [steps]}``.
-    """
-    directory = os.fspath(directory)
-    steps = valid_steps(directory)
-    target = latest_healthy_step(directory)
-    doomed = [s for s in steps if target is None or s > target]
-    moved: list[int] = []
-    for s in doomed:
-        _quarantine_move(directory, str(s))
-        moved.append(s)
-    if moved:
-        tele = get_telemetry()
-        tele.registry.counter("fault/rollbacks").inc()
-        tele.event(
-            "fault/rollback",
-            directory=directory,
-            to_step=target,
-            quarantined=moved,
-        )
-    return {"to_step": target, "quarantined": moved}
 
 
 def _target_topology(abstract: Any) -> dict | None:

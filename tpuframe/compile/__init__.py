@@ -22,6 +22,8 @@ remote launcher read its knob list from wedged-backend processes);
 exports here are lazy for the same reason.
 """
 
+# tpuframe-lint: stdlib-only
+
 from tpuframe.compile.cache import (
     COMPILE_ENV_VARS,
     cache_dir_from_env,

@@ -45,6 +45,8 @@ This module imports jax lazily (inside :func:`enable`): the doctor and
 from processes whose backend may be wedged.
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import contextlib

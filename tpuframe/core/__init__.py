@@ -1,40 +1,49 @@
-"""Core runtime: configuration, process/runtime init, device meshes, control plane."""
+"""Core runtime: configuration, process/runtime init, device meshes, control plane.
 
-from tpuframe.core.config import AUTO, Config, load_config
-from tpuframe.core.workspace import Workspace, export_worker_env
-from tpuframe.core.runtime import (
-    DATA_AXIS,
-    EXPERT_AXIS,
-    FSDP_AXIS,
-    MODEL_AXIS,
-    PIPELINE_AXIS,
-    SEQUENCE_AXIS,
-    MeshSpec,
-    Runtime,
-    current_runtime,
-    initialize,
-    is_main_process,
-    process_count,
-    process_index,
-)
+Exports resolve lazily (PEP 562): ``core.workspace`` (per-host layout,
+the ``PERF_ENV_VARS`` knob list) must be importable without dragging in
+``core.runtime``'s jax import — ``launch.remote.all_env_vars()`` and the
+doctor read the knob registry from wedged-backend (or jax-less)
+processes.  ``from tpuframe.core import X`` works exactly as before.
+Note ``core.config`` imports pyyaml, so even the config surface resolves
+lazily here.
+"""
 
-__all__ = [
-    "Workspace",
-    "export_worker_env",
-    "AUTO",
-    "Config",
-    "load_config",
-    "DATA_AXIS",
-    "FSDP_AXIS",
-    "MODEL_AXIS",
-    "PIPELINE_AXIS",
-    "SEQUENCE_AXIS",
-    "EXPERT_AXIS",
-    "MeshSpec",
-    "Runtime",
-    "current_runtime",
-    "initialize",
-    "is_main_process",
-    "process_count",
-    "process_index",
-]
+# tpuframe-lint: stdlib-only
+
+import importlib
+
+# name -> submodule it lives in (all under tpuframe.core)
+_EXPORTS = {
+    "AUTO": "config",
+    "Config": "config",
+    "load_config": "config",
+    "Workspace": "workspace",
+    "export_worker_env": "workspace",
+    "DATA_AXIS": "runtime",
+    "EXPERT_AXIS": "runtime",
+    "FSDP_AXIS": "runtime",
+    "MODEL_AXIS": "runtime",
+    "PIPELINE_AXIS": "runtime",
+    "SEQUENCE_AXIS": "runtime",
+    "MeshSpec": "runtime",
+    "Runtime": "runtime",
+    "current_runtime": "runtime",
+    "initialize": "runtime",
+    "is_main_process": "runtime",
+    "process_count": "runtime",
+    "process_index": "runtime",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        mod = importlib.import_module(f"tpuframe.core.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module 'tpuframe.core' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_EXPORTS)))

@@ -17,6 +17,8 @@ worker processes.
 >>> ws.local_scratch()               # per-host cache (≈ /local_disk0)
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import dataclasses
@@ -26,6 +28,23 @@ from typing import Mapping
 
 #: layout version written to the root marker; bump on breaking changes
 LAYOUT_VERSION = 1
+
+#: Input-pipeline / kernel-dispatch / debug knobs that must reach every
+#: worker — the fifth spine knob list, aggregated by
+#: ``launch.remote.all_env_vars()`` next to OBSERVABILITY/COMPILE/HEALTH/
+#: SERVE.  Declared here (stdlib-only module) so the aggregate resolves
+#: on a wedged-backend doctor run; documented in PERF.md.  A knob read
+#: anywhere in tpuframe that appears in no ``*_ENV_VARS`` list is a
+#: ``tpuframe.lint`` finding (KN001) — that is what keeps this list and
+#: its consumers honest.
+PERF_ENV_VARS = (
+    "TPUFRAME_NATIVE_JPEG",
+    "TPUFRAME_JPEG_THREADS",
+    "TPUFRAME_DISABLE_PALLAS",
+    "TPUFRAME_PALLAS_INTERPRET",
+    "TPUFRAME_DEBUG",
+    "TPUFRAME_CKPT_DIR",
+)
 
 
 @dataclasses.dataclass(frozen=True)

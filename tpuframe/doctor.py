@@ -12,6 +12,8 @@ remote backend ``jax.devices()`` hangs forever rather than erroring
 exactly the environment it should diagnose is useless.
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import importlib
@@ -155,7 +157,7 @@ def ckpt_section(directory: str | None = None,
     directory = directory or os.environ.get("TPUFRAME_CKPT_DIR")
     if not directory:
         return None
-    from tpuframe.ckpt.checkpoint import read_manifest, valid_steps
+    from tpuframe.ckpt.meta import read_manifest, valid_steps
 
     steps = valid_steps(directory)
     qdir = os.path.join(directory, "_quarantine")
@@ -229,7 +231,7 @@ def health_section(directory: str | None = None) -> dict:
     }
     directory = directory or os.environ.get("TPUFRAME_CKPT_DIR")
     if directory:
-        from tpuframe.ckpt.checkpoint import (
+        from tpuframe.ckpt.meta import (
             latest_healthy_step,
             latest_step,
             read_health,
@@ -308,6 +310,33 @@ def serve_section(export_path: str | None = None) -> dict:
     return out
 
 
+def lint_section() -> dict:
+    """State of the invariant linter (``tpuframe.lint``): the full pass
+    run in-process over the installed tree — finding count per rule and
+    the paste-ready one-liner, consistent with the compile/serve/ckpt
+    sections.  A bug report whose ``lint`` section is dirty says up
+    front that the tree's own contracts (jax-free modules, knob
+    shipping, telemetry schema) were already broken before whatever is
+    being reported.  Stdlib-only like the pass itself."""
+    from tpuframe.lint import run_lint
+
+    try:
+        result = run_lint()
+    except (OSError, SyntaxError, ValueError) as e:  # unreadable tree ≠
+        # doctor crash (ValueError covers UnicodeDecodeError/null bytes)
+        return {"error": f"{type(e).__name__}: {e}", "cmd": "python -m tpuframe.lint --json"}
+    return {
+        "findings": len(result.findings),
+        "clean": not result.findings,
+        "by_rule": result.rule_counts(),
+        "files_scanned": result.files_scanned,
+        "rules_run": result.rules_run,
+        # the paste-ready reproduction next to the verdict, like the
+        # telemetry section's analyze one-liner
+        "cmd": "python -m tpuframe.lint --json",
+    }
+
+
 def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
            export_path: str | None = None) -> dict:
     """Collect the full environment report (pure data; printing is main's)."""
@@ -353,6 +382,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "ckpt": ckpt_section(ckpt_dir, devices.get("device_count")),
         "health": health_section(ckpt_dir),
         "serve": serve_section(export_path),
+        "lint": lint_section(),
         "env": {
             k: os.environ[k]
             for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",

@@ -23,6 +23,8 @@ Like the telemetry spine it reports through, everything here except the
 multi-host agreement helper is stdlib-only and works while jax is wedged.
 """
 
+# tpuframe-lint: stdlib-only
+
 from tpuframe.fault.chaos import (
     ChaosError,
     ChaosPlan,

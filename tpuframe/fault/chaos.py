@@ -9,42 +9,21 @@ built from explicit injector lists or drawn from a seed
 (:meth:`ChaosPlan.scheduled`), so a failing chaos test reproduces
 exactly.
 
-Instrumented sites (the hot-path cost with no active plan is one global
-read):
-
-=================  =========================================================
-site               where
-=================  =========================================================
-``loader``         Trainer._run_epoch, before pulling the next host batch
-``batch``          Trainer host pipeline, on the assembled numpy train
-                   batch (ctx: ``images``) — where :class:`NaNAt` /
-                   :class:`SpikeAt` poison the data the jitted step eats
-``step``           Trainer._run_epoch, before dispatching the train step
-``ckpt/save``      Checkpointer.save, before the orbax write (inside the
-                   transient-IO retry window)
-``ckpt/saved``     Checkpointer.save, after the write (ctx: ``path``) —
-                   where :class:`TornCheckpoint` tears the commit marker
-``serve/submit``   ServeEngine.submit, before door validation (ctx:
-                   ``payload``, ``engine``) — where :class:`PoisonRequest`
-                   corrupts the client payload validation must reject
-``serve/enqueue``  ServeEngine.submit, after validation / before
-                   admission (ctx: ``engine``) — where :class:`QueueFlood`
-                   floods the bounded queue with synthetic load
-``serve/batch``    ServeEngine batcher, before batch assembly (ctx:
-                   ``n``, ``bucket``, ``engine``)
-``serve/infer``    ServeEngine batcher, inside the backend-call span —
-                   where :class:`SlowConsumer` wedges the backend under
-                   the serve watchdog lease
-=================  =========================================================
-
-Library code can add sites with :func:`site`/:func:`maybe_fire`; tests
-activate a plan with ``with plan.active(): ...``.  Every firing emits a
+Instrumented sites are declared in :data:`CHAOS_SITES` (the hot-path
+cost with no active plan is one global read).  Library code adds a site
+by instrumenting the call site with :func:`site`/:func:`maybe_fire`
+AND declaring it in :data:`CHAOS_SITES` AND documenting it in FAULT.md
+— the invariant linter (``python -m tpuframe.lint``, rules CS001-CS003)
+fails tier-1 when the three drift apart.  Tests activate a plan with
+``with plan.active(): ...``.  Every firing emits a
 ``fault/chaos_injected`` telemetry event and bumps the
 ``fault/chaos_injections`` counter, so a chaos run's event log shows the
 injected fault right next to the recovery it triggered.
 
 Stdlib-only; never imports jax.
 """
+
+# tpuframe-lint: stdlib-only
 
 from __future__ import annotations
 
@@ -59,6 +38,7 @@ from typing import Any, Iterator, Mapping, Sequence
 from tpuframe.track.telemetry import get_telemetry
 
 __all__ = [
+    "CHAOS_SITES",
     "ChaosError",
     "ChaosPlan",
     "Injector",
@@ -80,6 +60,49 @@ __all__ = [
     "reset_lost_ranks",
     "site",
 ]
+
+
+#: THE registry of instrumented injection sites: every site string fired
+#: through :func:`maybe_fire`/:func:`site` anywhere in tpuframe must have
+#: a row here (and a mention in FAULT.md), and every row must have a live
+#: call site — machine-checked by ``tpuframe.lint`` (CS001-CS003), so a
+#: renamed or orphaned site is a failing test, not silent chaos-coverage
+#: loss.  The value is the "where": which code path asks the active plan.
+CHAOS_SITES = {
+    "loader": "Trainer._run_epoch, before pulling the next host batch",
+    "batch": (
+        "Trainer host pipeline, on the assembled numpy train batch "
+        "(ctx: images) — where NaNAt/SpikeAt poison the data the "
+        "jitted step eats"
+    ),
+    "step": "Trainer._run_epoch, before dispatching the train step",
+    "ckpt/save": (
+        "Checkpointer.save, before the orbax write (inside the "
+        "transient-IO retry window)"
+    ),
+    "ckpt/saved": (
+        "Checkpointer.save, after the write (ctx: path) — where "
+        "TornCheckpoint tears the commit marker"
+    ),
+    "serve/submit": (
+        "ServeEngine.submit, before door validation (ctx: payload, "
+        "engine) — where PoisonRequest corrupts the client payload "
+        "validation must reject"
+    ),
+    "serve/enqueue": (
+        "ServeEngine.submit, after validation / before admission "
+        "(ctx: engine) — where QueueFlood floods the bounded queue "
+        "with synthetic load"
+    ),
+    "serve/batch": (
+        "ServeEngine batcher, before batch assembly (ctx: n, bucket, "
+        "engine)"
+    ),
+    "serve/infer": (
+        "ServeEngine batcher, inside the backend-call span — where "
+        "SlowConsumer wedges the backend under the serve watchdog lease"
+    ),
+}
 
 
 class ChaosError(OSError):
@@ -179,7 +202,7 @@ class TornCheckpoint(Injector):
         super().__init__("ckpt/saved", step, times=times)
 
     def fire(self, ctx: Mapping[str, Any]) -> None:
-        from tpuframe.ckpt.checkpoint import COMMIT_MARKERS
+        from tpuframe.ckpt.meta import COMMIT_MARKERS
 
         path = ctx.get("path")
         if not path:

@@ -27,7 +27,7 @@ training is a production requirement"):
    :class:`Divergence` — a dedicated supervisor failure class with its
    own restart budget.  The supervisor **rolls back to the last
    checkpoint whose health stamp says healthy**
-   (``ckpt.checkpoint.rollback_to_last_healthy``; every save stamps
+   (``ckpt.meta.rollback_to_last_healthy``; every save stamps
    loss-EWMA/grad-norm/bad-step state next to the topology manifest)
    and re-enters with a perturbation — LR backoff and/or a data-order
    skip past the poison window — so a deterministic replay does not
@@ -39,6 +39,8 @@ Module import is stdlib-only (jax is imported lazily inside the
 device-side helpers), so the supervisor keeps working while jax is
 wedged.
 """
+
+# tpuframe-lint: stdlib-only
 
 from __future__ import annotations
 
@@ -322,7 +324,7 @@ def health_stamp(hstate: Mapping[str, Any], step: int,
                  policy: HealthPolicy) -> dict:
     """The JSON health record :meth:`Checkpointer.save` embeds next to
     the topology manifest — read back (stdlib-only,
-    ``ckpt.checkpoint.read_health``) by rollback and the doctor.
+    ``ckpt.meta.read_health``) by rollback and the doctor.
     ``healthy`` means the newest bad step is at least one full check
     window behind this save (or there never was one)."""
     def _f(v) -> float | None:

@@ -31,6 +31,8 @@ two often arrive together: the reclaim that sends SIGTERM also yanks
 the TPU runtime out from under in-flight collectives).
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import os

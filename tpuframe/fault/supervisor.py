@@ -17,7 +17,7 @@ Three upgrades over the 58-line constant-backoff loop it replaces:
 3. **Pre-resume checkpoint validation.**  A crash mid-save leaves a torn
    step directory; auto-resume pointing at it crash-loops into corrupt
    state.  Before every attempt the supervisor quarantines torn steps
-   (``ckpt.checkpoint.quarantine_torn_steps``) so ``maybe_restore``
+   (``ckpt.meta.quarantine_torn_steps``) so ``maybe_restore``
    lands on the newest *committed* step.
 
 Every decision is observable: ``fault/restart`` events carry the
@@ -34,6 +34,8 @@ below ``min_world_size`` — TorchTitan's "recoverable AND reconfigurable"
 production requirement, instead of retrying into a world that no longer
 exists until the budget dies.
 """
+
+# tpuframe-lint: stdlib-only
 
 from __future__ import annotations
 
@@ -227,7 +229,7 @@ class Supervisor:
         ``_intra`` snapshot sibling; returns quarantined paths."""
         if self.checkpoint_dir is None:
             return []
-        from tpuframe.ckpt.checkpoint import quarantine_torn_steps
+        from tpuframe.ckpt.meta import quarantine_torn_steps
 
         moved: list[str] = []
         for d in (self.checkpoint_dir, str(self.checkpoint_dir) + "_intra"):
@@ -314,7 +316,7 @@ class Supervisor:
             "skip_batches": directive.skip_batches,
         }
         if self.checkpoint_dir is not None:
-            from tpuframe.ckpt.checkpoint import rollback_to_last_healthy
+            from tpuframe.ckpt.meta import rollback_to_last_healthy
 
             targets: list[int | None] = []
             for d in (self.checkpoint_dir, str(self.checkpoint_dir) + "_intra"):

@@ -73,25 +73,50 @@ def ssh_connect(host: str) -> list[str]:
     return ["ssh", "-o", "BatchMode=yes", host]
 
 
+#: Per-rank launch-contract and host-local infrastructure env — values the
+#: launcher COMPUTES for each worker (rank, coordinator address, control-
+#: plane ports/credentials, host scratch) rather than copying from the
+#: client env, so they are deliberately NOT part of :func:`all_env_vars`.
+#: Declared so every ``TPUFRAME_*`` read in the tree is accounted for in
+#: exactly one registry (``tpuframe.lint`` rule KN001); table in FAULT.md.
+LAUNCH_CONTRACT_ENV_VARS = (  # tpuframe-lint: not-shipped
+    "TPUFRAME_PROCESS_ID",
+    "TPUFRAME_NUM_PROCESSES",
+    "TPUFRAME_COORDINATOR",
+    "TPUFRAME_CP_PORT",
+    "TPUFRAME_CP_TOKEN",
+    "TPUFRAME_CP_BIND",
+    "TPUFRAME_HB_PORT",
+    "TPUFRAME_HB_ADDR",
+    "TPUFRAME_SIMULATE_DEVICES",
+    "TPUFRAME_RESULT_DIR",
+    "TPUFRAME_LOCAL_SCRATCH",
+    "TPUFRAME_NATIVE_KEEP_BUILDS",
+)
+
+
 def all_env_vars() -> tuple[str, ...]:
     """Every spine's env-knob list, aggregated — THE single registry
     consumed by remote worker shipping (below) and the doctor.
 
     Each spine declares its own list next to its knobs
     (``OBSERVABILITY_ENV_VARS``, ``COMPILE_ENV_VARS``,
-    ``HEALTH_ENV_VARS``, ``SERVE_ENV_VARS``); new spines add themselves
-    HERE, and both consumers pick them up for free — the concrete first
-    step toward the ROADMAP item-5 typed knob registry.  All four source
-    modules are stdlib-only imports (no jax), so this resolves on a
-    wedged-backend doctor run too.
+    ``HEALTH_ENV_VARS``, ``SERVE_ENV_VARS``, ``PERF_ENV_VARS``); new
+    spines add themselves HERE, and both consumers pick them up for free
+    — the concrete first step toward the ROADMAP item-5 typed knob
+    registry.  All five source modules are stdlib-only imports (no jax),
+    so this resolves on a wedged-backend doctor run too.  The invariant
+    linter (``tpuframe.lint`` rule KN004) fails tier-1 if a knob list
+    exists that this aggregate does not reach.
     """
     from tpuframe.compile.cache import COMPILE_ENV_VARS
+    from tpuframe.core.workspace import PERF_ENV_VARS
     from tpuframe.fault.health import HEALTH_ENV_VARS
     from tpuframe.serve.admission import SERVE_ENV_VARS
     from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
 
     return (OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS + HEALTH_ENV_VARS
-            + SERVE_ENV_VARS)
+            + SERVE_ENV_VARS + PERF_ENV_VARS)
 
 
 class _Worker:

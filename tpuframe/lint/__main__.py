@@ -1,0 +1,73 @@
+"""CLI: ``python -m tpuframe.lint [--json] [--suppressions FILE] [--knobs]``.
+
+Exit codes mirror the fleet analyzer's regression-gate convention:
+0 = clean, 3 = unsuppressed findings (CI-gateable), 2 = usage error.
+"""
+
+# tpuframe-lint: stdlib-only
+
+import argparse
+import json
+import sys
+
+from tpuframe.lint.driver import load_repo, run_lint
+from tpuframe.lint.report import Suppressions, render_json, render_text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuframe.lint",
+        description=(
+            "tpuframe invariant linter: jax-free contracts, knob "
+            "accounting, telemetry schema drift, hot-path hazards, "
+            "chaos-site registry (rule catalog in LINT.md)"
+        ),
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--suppressions", default=None, metavar="FILE",
+                    help="suppressions file (RULE:file-glob[:substr] lines)")
+    ap.add_argument("--root", default=None, metavar="DIR",
+                    help="package dir to scan (default: the installed "
+                         "tpuframe package)")
+    ap.add_argument("--docs", default=None, metavar="DIR",
+                    help="dir holding the schema docs (default: the "
+                         "package dir's parent)")
+    ap.add_argument("--knobs", action="store_true",
+                    help="emit the reconciled TPUFRAME_* knob inventory "
+                         "instead of findings (the core/config registry "
+                         "seam; pairs with --json)")
+    args = ap.parse_args(argv)
+
+    try:
+        suppressions = (Suppressions.load(args.suppressions)
+                        if args.suppressions else None)
+    except (OSError, ValueError) as e:
+        print(f"tpuframe.lint: bad suppressions file: {e}", file=sys.stderr)
+        return 2
+
+    if args.knobs:
+        from tpuframe.lint.knobs import knob_inventory
+
+        inventory = knob_inventory(load_repo(args.root, args.docs))
+        if args.as_json:
+            print(json.dumps({"knobs": inventory, "count": len(inventory)},
+                             indent=2))
+        else:
+            for row in inventory:
+                lists = ", ".join(row["lists"]) or "UNDECLARED"
+                docs = ", ".join(row["docs"]) or "undocumented"
+                default = (f" default={row['defaults'][0]!r}"
+                           if row["defaults"] else "")
+                print(f"{row['name']}: {lists}{default} [{docs}] "
+                      f"({len(row['reads'])} read site(s))")
+            print(f"{len(inventory)} knob(s)")
+        return 0
+
+    result = run_lint(args.root, args.docs, suppressions)
+    print(render_json(result) if args.as_json else render_text(result))
+    return 3 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,170 @@
+"""JF rules — the jax-free contract, verified over the real import graph.
+
+A module marked ``# tpuframe-lint: stdlib-only`` promises it is
+importable with nothing but the standard library installed — the
+telemetry/fault/doctor stack's "works while jax is wedged (or absent)"
+story rests on it.  Prose can't keep that promise; imports can break it
+three ways, and each is a rule:
+
+- **JF001** — the marked module itself imports a non-stdlib package at
+  module level (``import jax``, ``import numpy``, ...).  Lazy
+  function-level imports are the sanctioned escape hatch and are not
+  findings.
+- **JF002** — the marked module imports, at module level, a tpuframe
+  module that is *not* marked: the contract must hold transitively, and
+  an unmarked dependency is unchecked territory.  Package ``__init__``
+  execution counts — importing ``tpuframe.a.b`` runs ``tpuframe/
+  __init__.py`` and ``tpuframe/a/__init__.py``, so those must be marked
+  (i.e. lazy / stdlib-clean) too.  This is exactly the drift that broke
+  nothing until a doctor ran against a wedged backend.
+
+``from __future__``, ``typing``-only blocks guarded by
+``if TYPE_CHECKING:``, and imports inside functions are exempt (they
+don't execute at import time).
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Iterator
+
+from tpuframe.lint.driver import Repo, SourceFile
+from tpuframe.lint.report import Finding
+
+RULES = {
+    "JF001": "stdlib-only module imports a non-stdlib package at module level",
+    "JF002": "stdlib-only module imports an unmarked tpuframe module at module level",
+}
+
+_STDLIB = frozenset(sys.stdlib_module_names) | {"__future__"}
+
+
+def _is_type_checking_if(node: ast.If) -> bool:
+    t = node.test
+    return (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or (
+        isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING"
+    )
+
+
+def module_level_imports(
+    tree: ast.Module,
+) -> Iterator[ast.Import | ast.ImportFrom]:
+    """Imports that execute when the module does: top-level statements,
+    descending through module-level ``if``/``try`` bodies (an import
+    under ``try: ... except ImportError`` still runs), skipping
+    ``if TYPE_CHECKING:`` blocks and function/class bodies."""
+    stack: list[ast.stmt] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+        elif isinstance(node, ast.If):
+            if not _is_type_checking_if(node):
+                stack.extend(node.body)
+            stack.extend(node.orelse)
+        elif isinstance(node, ast.Try):
+            stack.extend(node.body)
+            stack.extend(node.orelse)
+            stack.extend(node.finalbody)
+            for h in node.handlers:
+                stack.extend(h.body)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            # `with contextlib.suppress(ImportError): import numpy` still
+            # executes the import at module level
+            stack.extend(node.body)
+
+
+def _internal_closure(repo: Repo, dotted: str) -> list[str]:
+    """The repo modules executed by importing ``dotted``: every package
+    ``__init__`` on the path, plus the module itself when it exists."""
+    parts = dotted.split(".")
+    out = []
+    for i in range(1, len(parts) + 1):
+        name = ".".join(parts[:i])
+        if name in repo.files:
+            out.append(name)
+    return out
+
+
+def resolve_import(
+    repo: Repo, src: SourceFile, node: ast.Import | ast.ImportFrom
+) -> tuple[list[str], list[str]]:
+    """(internal module names executed, external top-level names imported)."""
+    internal: list[str] = []
+    external: list[str] = []
+
+    def add(dotted: str) -> None:
+        if dotted.split(".")[0] == repo.package:
+            internal.extend(_internal_closure(repo, dotted))
+        else:
+            external.append(dotted.split(".")[0])
+
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            add(alias.name)
+        return internal, external
+
+    base = node.module or ""
+    if node.level:  # relative: resolve against this module's package
+        pkg_parts = src.module.split(".")
+        if not src.path.endswith("__init__.py"):
+            pkg_parts = pkg_parts[:-1]
+        if node.level > 1:
+            pkg_parts = pkg_parts[: -(node.level - 1)] or pkg_parts[:1]
+        base = ".".join(pkg_parts + ([node.module] if node.module else []))
+    add(base)
+    # `from pkg.mod import name` may bind submodules too
+    if base.split(".")[0] == repo.package:
+        for alias in node.names:
+            sub = f"{base}.{alias.name}"
+            if sub in repo.files:
+                internal.extend(_internal_closure(repo, sub))
+    return internal, external
+
+
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in repo.files.values():
+        if not src.stdlib_only:
+            continue
+        for node in module_level_imports(src.tree):
+            internal, external = resolve_import(repo, src, node)
+            for top in external:
+                if top in _STDLIB:
+                    continue
+                findings.append(Finding(
+                    rule="JF001",
+                    file=src.rel,
+                    line=node.lineno,
+                    message=(
+                        f"module is marked stdlib-only but imports "
+                        f"{top!r} at module level"
+                    ),
+                    hint=(
+                        "import it lazily inside the function that needs "
+                        "it, or remove the '# tpuframe-lint: stdlib-only' "
+                        "marker and every contract that relies on it"
+                    ),
+                ))
+            for dep in dict.fromkeys(internal):
+                if dep == src.module or repo.files[dep].stdlib_only:
+                    continue
+                findings.append(Finding(
+                    rule="JF002",
+                    file=src.rel,
+                    line=node.lineno,
+                    message=(
+                        f"module is marked stdlib-only but imports "
+                        f"unmarked module {dep!r} at module level (package "
+                        "__init__ execution counts)"
+                    ),
+                    hint=(
+                        f"mark {dep} '# tpuframe-lint: stdlib-only' if it "
+                        "qualifies (the linter will then hold it to the "
+                        "same contract), or make this import lazy"
+                    ),
+                ))
+    return findings

@@ -1,0 +1,430 @@
+"""KN rules — TPUFRAME_* knob accounting across lists, reads, and docs.
+
+The spines ship their env knobs to every worker through
+``launch.remote.all_env_vars()``, which aggregates the per-spine
+``*_ENV_VARS`` lists; the doctor prints the same registry.  A knob read
+in code but absent from every list silently never reaches the fleet — a
+worker tuned locally behaves untuned remotely, the exact class of bug
+this family exists to kill.  Rules:
+
+- **KN001** — a literal ``TPUFRAME_*`` env read with no declaring list.
+- **KN002** — a knob declared in more than one list (ambiguous owner).
+- **KN003** — a declared knob that no code reads (dead registry row —
+  usually a renamed knob whose list entry was forgotten).
+- **KN004** — a shipped list (no ``# tpuframe-lint: not-shipped`` marker
+  on its assignment line) that ``all_env_vars()`` does not aggregate.
+- **KN005** — a declared knob documented in none of OBSERVABILITY.md /
+  FAULT.md / SERVE.md / PERF.md.
+
+Read detection covers ``os.environ.get/[]``, ``os.getenv``,
+``"X" in os.environ``, and one level of indirection: any function whose
+body reads the environment through one of its parameters (``_env_int``,
+``_env_truthy``, ...) turns its literal-name call sites into reads, with
+the call's constant companion argument recorded as the default — which
+is how ``--knobs`` reconstructs the inventory the future ``core/config``
+typed registry will consume.
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any
+
+from tpuframe.lint.driver import DOC_FILES, Repo
+from tpuframe.lint.report import Finding
+
+RULES = {
+    "KN001": "TPUFRAME_* env read not declared in any *_ENV_VARS list",
+    "KN002": "knob declared in more than one *_ENV_VARS list",
+    "KN003": "declared knob never read anywhere in code",
+    "KN004": "shipped *_ENV_VARS list not aggregated by all_env_vars()",
+    "KN005": "declared knob documented in no schema doc",
+    "KN006": "all_env_vars() imports a knob list from a non-stdlib-only module",
+}
+
+_PREFIX = "TPUFRAME_"
+
+
+@dataclasses.dataclass
+class KnobList:
+    name: str
+    module: str
+    rel: str
+    line: int
+    entries: tuple[str, ...]
+    shipped: bool
+
+
+@dataclasses.dataclass
+class KnobRead:
+    name: str
+    rel: str
+    line: int
+    default: Any = None
+    has_default: bool = False
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def collect_lists(repo: Repo) -> list[KnobList]:
+    out = []
+    for src in repo.files.values():
+        for node in src.nodes:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not (isinstance(target, ast.Name)
+                    and target.id.endswith("_ENV_VARS")):
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            entries = tuple(
+                v for v in (_const_str(e) for e in node.value.elts)
+                if v is not None
+            )
+            shipped = not any(
+                d == "not-shipped"
+                for line, d in src.directive_lines.items()
+                if node.lineno <= line <= (node.end_lineno or node.lineno)
+            )
+            out.append(KnobList(
+                name=target.id, module=src.module, rel=src.rel,
+                line=node.lineno, entries=entries, shipped=shipped,
+            ))
+    return out
+
+
+def _env_param_readers(repo: Repo) -> dict[str, int]:
+    """Function name -> positional index of its env-name parameter, for
+    functions that read the environment through a parameter — iterated to
+    a fixpoint so wrappers of wrappers count (``_env_int`` delegating to
+    ``_env_float`` which does the ``os.environ.get``)."""
+    # one AST pass per def extracts the two candidate shapes (direct env
+    # reads of a param; delegations to another function); the fixpoint
+    # then iterates over that compact summary, not the trees
+    summaries = []  # (name, params, direct_param_names, [(callee, args)])
+    for src in repo.files.values():
+        for node in src.nodes:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.args]
+            direct: set[str] = set()
+            calls: list[tuple[str, list]] = []
+            for inner in ast.walk(node):
+                name_arg = _direct_env_name_expr(inner)
+                if isinstance(name_arg, ast.Name) and name_arg.id in params:
+                    direct.add(name_arg.id)
+                elif isinstance(inner, ast.Call):
+                    func = inner.func
+                    callee = func.attr if isinstance(func, ast.Attribute) \
+                        else (func.id if isinstance(func, ast.Name) else None)
+                    if callee is not None and any(
+                        isinstance(a, ast.Name) and a.id in params
+                        for a in inner.args
+                    ):
+                        calls.append((callee, inner.args))
+            summaries.append((node.name, params, direct, calls))
+
+    readers: dict[str, int] = {}
+    changed = True
+    while changed:
+        changed = False
+        for name, params, direct, calls in summaries:
+            if name in readers:
+                continue
+            hit = next(iter(direct), None)
+            if hit is None:
+                for callee, args in calls:
+                    idx = readers.get(callee)
+                    if (idx is not None and idx < len(args)
+                            and isinstance(args[idx], ast.Name)
+                            and args[idx].id in params):
+                        hit = args[idx].id
+                        break
+            if hit is not None:
+                readers[name] = params.index(hit)
+                changed = True
+    return readers
+
+
+def _name_constants(src) -> dict[str, str]:
+    """name -> TPUFRAME_* string for ``FOO_ENV = "TPUFRAME_X"``-style
+    bindings anywhere in the module, so reads through the symbol count."""
+    out: dict[str, str] = {}
+    for node in src.nodes:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            v = _const_str(node.value)
+            if v is not None and v.startswith(_PREFIX):
+                out[node.targets[0].id] = v
+    return out
+
+
+def _direct_env_name_expr(node: ast.AST) -> ast.AST | None:
+    """The name-expression of a direct environment read at ``node``
+    (``environ.get(X)``, ``getenv(X)``, ``environ[X]``, ``X in environ``),
+    or None."""
+    if isinstance(node, ast.Call):
+        func = node.func
+        attr = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if attr == "getenv" and node.args:
+            return node.args[0]
+        if attr in ("get", "pop", "setdefault") and node.args:
+            recv = func.value if isinstance(func, ast.Attribute) else None
+            if isinstance(recv, ast.Attribute) and recv.attr == "environ":
+                return node.args[0]
+            if isinstance(recv, ast.Name) and recv.id in ("environ", "env"):
+                return node.args[0]
+    elif isinstance(node, ast.Subscript):
+        v = node.value
+        if (isinstance(v, ast.Attribute) and v.attr == "environ") or (
+            isinstance(v, ast.Name) and v.id == "environ"
+        ):
+            return node.slice
+    elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+        if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            c = node.comparators[0]
+            if (isinstance(c, ast.Attribute) and c.attr == "environ") or (
+                isinstance(c, ast.Name) and c.id == "environ"
+            ):
+                return node.left
+    return None
+
+
+def collect_reads(repo: Repo) -> list[KnobRead]:
+    """Every literal TPUFRAME_* environment read (direct or through a
+    reader helper), plus literal ``.get``/``[]``/``in`` accesses on
+    constructed env mappings (worker-env plumbing reads count too)."""
+    readers = _env_param_readers(repo)
+    reads: list[KnobRead] = []
+    consts: dict[str, str] = {}
+
+    def add(src, node, name_node, default=None, has_default=False):
+        name = _const_str(name_node)
+        if name is None and isinstance(name_node, ast.Name):
+            name = consts.get(name_node.id)
+        if name is None or not name.startswith(_PREFIX):
+            return
+        reads.append(KnobRead(
+            name=name, rel=src.rel, line=node.lineno,
+            default=default, has_default=has_default,
+        ))
+
+    for src in repo.files.values():
+        consts = _name_constants(src)
+        for node in src.nodes:
+            direct = _direct_env_name_expr(node)
+            if direct is not None:
+                default, has_default = None, False
+                if isinstance(node, ast.Call) and len(node.args) > 1:
+                    d = node.args[1]
+                    if isinstance(d, ast.Constant):
+                        default, has_default = d.value, True
+                add(src, node, direct, default, has_default)
+                continue
+            # generic mapping access with a TPUFRAME_ literal key
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                add(src, node, node.slice)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                attr = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else None
+                )
+                if attr == "get" and node.args:
+                    add(src, node, node.args[0])
+                elif attr in readers and node.args:
+                    idx = readers[attr]
+                    if idx < len(node.args):
+                        default, has_default = None, False
+                        for other in node.args[idx + 1:]:
+                            if isinstance(other, ast.Constant):
+                                default, has_default = other.value, True
+                                break
+                        add(src, node, node.args[idx], default, has_default)
+    return reads
+
+
+def _aggregated_list_names(repo: Repo) -> set[str]:
+    """List names reachable from ``all_env_vars()``: every ``*_ENV_VARS``
+    name loaded or imported inside that function's body."""
+    out: set[str] = set()
+    for src in repo.files.values():
+        for node in src.nodes:
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == "all_env_vars"):
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Name) and inner.id.endswith(
+                        "_ENV_VARS"
+                    ):
+                        out.add(inner.id)
+                    elif isinstance(inner, ast.ImportFrom):
+                        out.update(
+                            a.name for a in inner.names
+                            if a.name.endswith("_ENV_VARS")
+                        )
+    return out
+
+
+def knob_inventory(repo: Repo) -> list[dict]:
+    """The reconciled inventory ``--knobs`` emits: one row per knob with
+    its declaring list(s), parseable default(s), read sites, and doc
+    locations — the machine-readable input for the future ``core/config``
+    typed knob registry (ROADMAP item 5)."""
+    lists = collect_lists(repo)
+    reads = collect_reads(repo)
+    by_name: dict[str, dict] = {}
+
+    def row(name: str) -> dict:
+        return by_name.setdefault(name, {
+            "name": name, "lists": [], "defaults": [], "reads": [],
+            "docs": [], "shipped": False,
+        })
+
+    for kl in lists:
+        for name in kl.entries:
+            r = row(name)
+            r["lists"].append(f"{kl.module}.{kl.name}")
+            r["shipped"] = r["shipped"] or kl.shipped
+    for rd in reads:
+        r = row(rd.name)
+        r["reads"].append(f"{rd.rel}:{rd.line}")
+        if rd.has_default and rd.default is not None \
+                and rd.default not in r["defaults"]:
+            r["defaults"].append(rd.default)
+    for name, r in by_name.items():
+        r["docs"] = [d for d in DOC_FILES if name in repo.docs.get(d, "")]
+    return [by_name[k] for k in sorted(by_name)]
+
+
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    lists = collect_lists(repo)
+    reads = collect_reads(repo)
+    aggregated = _aggregated_list_names(repo)
+
+    declared: dict[str, list[KnobList]] = {}
+    for kl in lists:
+        for name in kl.entries:
+            declared.setdefault(name, []).append(kl)
+    read_names = {r.name for r in reads}
+
+    seen_undeclared: set[str] = set()
+    for rd in reads:
+        if rd.name in declared or rd.name in seen_undeclared:
+            continue
+        seen_undeclared.add(rd.name)
+        findings.append(Finding(
+            rule="KN001", file=rd.rel, line=rd.line,
+            message=(
+                f"env knob {rd.name!r} is read here but declared in no "
+                "*_ENV_VARS list — workers launched remotely will never "
+                "receive it"
+            ),
+            hint=(
+                "add it to the owning spine's *_ENV_VARS list (or to "
+                "LAUNCH_CONTRACT_ENV_VARS in launch/remote.py if the "
+                "launcher computes it per rank)"
+            ),
+        ))
+
+    for name, owners in declared.items():
+        if len(owners) > 1:
+            findings.append(Finding(
+                rule="KN002", file=owners[1].rel, line=owners[1].line,
+                message=(
+                    f"knob {name!r} is declared in "
+                    f"{len(owners)} lists: "
+                    + ", ".join(f"{o.module}.{o.name}" for o in owners)
+                ),
+                hint="keep exactly one declaring list per knob",
+            ))
+        if name not in read_names:
+            findings.append(Finding(
+                rule="KN003", file=owners[0].rel, line=owners[0].line,
+                message=(
+                    f"knob {name!r} is declared in {owners[0].name} but "
+                    "never read anywhere in the tree"
+                ),
+                hint=(
+                    "delete the stale entry, or wire the knob up — a "
+                    "declared-but-unread knob is a silent no-op for users "
+                    "who set it"
+                ),
+            ))
+
+    # KN006: the aggregate must resolve on a wedged/jax-less process —
+    # every module all_env_vars() imports a list from (and every package
+    # __init__ executed on the way) must carry the stdlib-only contract.
+    # JF can't see this (function-level imports are its sanctioned lazy
+    # escape hatch); the knob registry is the one place laziness is not
+    # enough, because the doctor calls this function on broken installs.
+    from tpuframe.lint.imports import resolve_import
+
+    for src in repo.files.values():
+        for node in src.nodes:
+            if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == "all_env_vars"):
+                continue
+            for inner in ast.walk(node):
+                if not isinstance(inner, (ast.Import, ast.ImportFrom)):
+                    continue
+                internal, _ = resolve_import(repo, src, inner)
+                for dep in dict.fromkeys(internal):
+                    if repo.files[dep].stdlib_only:
+                        continue
+                    findings.append(Finding(
+                        rule="KN006", file=src.rel, line=inner.lineno,
+                        message=(
+                            f"all_env_vars() imports through {dep!r}, "
+                            "which is not marked stdlib-only — the doctor "
+                            "reads the knob registry on wedged/jax-less "
+                            "processes, so this import chain must never "
+                            "drag in a heavy dependency"
+                        ),
+                        hint=(
+                            f"make {dep} stdlib-only (lazy package "
+                            "__init__, marker comment) or declare the "
+                            "list in a module that already is"
+                        ),
+                    ))
+
+    for kl in lists:
+        if kl.shipped and kl.name not in aggregated:
+            findings.append(Finding(
+                rule="KN004", file=kl.rel, line=kl.line,
+                message=(
+                    f"{kl.name} is not aggregated by "
+                    "launch.remote.all_env_vars() — its knobs never ship "
+                    "to remote workers"
+                ),
+                hint=(
+                    "import and add it inside all_env_vars(), or mark the "
+                    "assignment '# tpuframe-lint: not-shipped' if the "
+                    "launcher computes these per rank"
+                ),
+            ))
+
+    if repo.docs:
+        for name, owners in sorted(declared.items()):
+            if any(name in text for text in repo.docs.values()):
+                continue
+            findings.append(Finding(
+                rule="KN005", file=owners[0].rel, line=owners[0].line,
+                message=(
+                    f"knob {name!r} is documented in none of "
+                    + "/".join(DOC_FILES)
+                ),
+                hint="add a row to the owning spine's knob table",
+            ))
+    return findings

@@ -1,0 +1,126 @@
+"""Findings, suppressions, and output rendering for the invariant linter.
+
+A :class:`Finding` is one violated contract: rule id, ``file:line``
+anchor, a one-line message, and a one-line fix hint.  Two suppression
+channels exist, both designed to be *visible in review*:
+
+- inline — ``# tpuframe-lint: disable=KN001`` (comma-separated ids, or
+  ``disable=all``) as a real comment on the finding's line; parsed with
+  ``tokenize``, so the same text inside a docstring does not count;
+- a suppressions file (``--suppressions``) with one
+  ``RULE:file-glob[:message-substring]`` entry per line — the repo's
+  own file must stay empty or justified line-by-line (see LINT.md).
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+from typing import Any, Iterable
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violated invariant at one source location."""
+
+    rule: str      # e.g. "KN001"
+    file: str      # repo-relative path ("tpuframe/track/telemetry.py")
+    line: int      # 1-based
+    message: str   # what drifted
+    hint: str      # how to fix it
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}\n" \
+               f"    fix: {self.hint}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Suppressions:
+    """Parsed ``--suppressions`` file: ``RULE:file-glob[:substr]`` lines.
+
+    ``#`` comments and blank lines are ignored.  ``RULE`` may be ``*``;
+    the optional third field matches as a substring of the message —
+    narrow enough that one entry cannot quietly swallow a whole rule's
+    future findings unless it explicitly asks to (``RULE:*``).
+    """
+
+    def __init__(self, entries: Iterable[tuple[str, str, str]] = ()):
+        self.entries = list(entries)
+
+    @classmethod
+    def parse(cls, text: str) -> "Suppressions":
+        entries = []
+        for raw in text.splitlines():
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split(":", 2)
+            if len(parts) < 2:
+                raise ValueError(
+                    f"bad suppression line {raw!r}: want RULE:file-glob[:substr]"
+                )
+            rule, pattern = parts[0].strip(), parts[1].strip()
+            substr = parts[2].strip() if len(parts) > 2 else ""
+            entries.append((rule, pattern, substr))
+        return cls(entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Suppressions":
+        with open(path) as f:
+            return cls.parse(f.read())
+
+    def matches(self, finding: Finding) -> bool:
+        for rule, pattern, substr in self.entries:
+            if rule not in ("*", finding.rule):
+                continue
+            if not fnmatch.fnmatch(finding.file, pattern):
+                continue
+            if substr and substr not in finding.message:
+                continue
+            return True
+        return False
+
+
+def split_suppressed(
+    findings: Iterable[Finding], suppressions: Suppressions | None
+) -> tuple[list[Finding], list[Finding]]:
+    """(kept, suppressed) under the suppressions file (inline disables
+    are already applied by the driver, per-line, before this)."""
+    kept: list[Finding] = []
+    dropped: list[Finding] = []
+    for f in findings:
+        (dropped if suppressions is not None and suppressions.matches(f)
+         else kept).append(f)
+    return kept, dropped
+
+
+def render_text(result: Any) -> str:
+    """Human-readable report (``result`` is a ``driver.LintResult``)."""
+    out = []
+    for f in result.findings:
+        out.append(f.format())
+    out.append(
+        f"tpuframe.lint: {len(result.findings)} finding(s) "
+        f"({result.suppressed_count} suppressed) over "
+        f"{result.files_scanned} file(s), {result.rules_run} rule(s)"
+    )
+    return "\n".join(out)
+
+
+def render_json(result: Any) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_json() for f in result.findings],
+            "counts": result.rule_counts(),
+            "suppressed": result.suppressed_count,
+            "files_scanned": result.files_scanned,
+            "rules_run": result.rules_run,
+            "clean": not result.findings,
+        },
+        indent=2,
+    )

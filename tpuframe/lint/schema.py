@@ -1,0 +1,127 @@
+"""TS rules — telemetry names vs the schema docs, both directions.
+
+OBSERVABILITY.md (with the serve/fault families detailed in SERVE.md and
+FAULT.md) is the schema of record for every span/event/counter/gauge/
+histogram name: dashboards, the fleet analyzer, and the runbooks all key
+on those names.  An undocumented name is unmonitorable by anyone who
+didn't read the diff; a documented-but-gone name is a dashboard
+silently flatlining.  Rules:
+
+- **TS001** — a slash-namespaced name literal passed to
+  ``span``/``event``/``counter``/``gauge``/``histogram``/``guard``
+  appears in none of the schema docs.
+- **TS002** — a slash-namespaced name backticked in a schema doc is
+  emitted nowhere in code (dynamic families — ``span/*`` auto
+  histograms, ``system/device<i>_*`` — and chaos site names are
+  excluded; sites are CS territory).
+
+Names built with f-strings are dynamic and skipped — document the
+family in prose instead (the ``span/<span name>`` convention).
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpuframe.lint.driver import Repo
+from tpuframe.lint.report import Finding
+from tpuframe.lint.sites import declared_sites
+
+RULES = {
+    "TS001": "telemetry name used in code but absent from the schema docs",
+    "TS002": "telemetry name documented but emitted nowhere in code",
+}
+
+#: docs that carry schema rows for telemetry names
+SCHEMA_DOCS = ("OBSERVABILITY.md", "FAULT.md", "SERVE.md")
+
+_EMITTERS = ("span", "event", "counter", "gauge", "histogram", "guard")
+
+#: backticked `layer/thing` tokens in the docs (letters/digits/underscore
+#: segments only — placeholders like `span/<span name>` self-exclude)
+_DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*(?:/[a-z0-9_]+)+)`")
+
+
+def code_names(repo: Repo) -> dict[str, list[tuple[str, int]]]:
+    """name -> [(file, line)] for every literal slash-namespaced name
+    passed to a telemetry emitter method."""
+    out: dict[str, list[tuple[str, int]]] = {}
+    for src in repo.files.values():
+        for node in src.nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EMITTERS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and "/" in arg.value):
+                out.setdefault(arg.value, []).append((src.rel, node.lineno))
+    return out
+
+
+def doc_names(repo: Repo) -> dict[str, str]:
+    """name -> first doc file that backticks it."""
+    out: dict[str, str] = {}
+    for doc in SCHEMA_DOCS:
+        for m in _DOC_NAME_RE.finditer(repo.docs.get(doc, "")):
+            out.setdefault(m.group(1), doc)
+    return out
+
+
+def check(repo: Repo) -> list[Finding]:
+    if not any(repo.docs.get(d) for d in SCHEMA_DOCS):
+        return []  # installed-package mode: nothing to diff against
+    findings: list[Finding] = []
+    used = code_names(repo)
+    documented = doc_names(repo)
+    sites = set(declared_sites(repo))
+
+    for name, where in sorted(used.items()):
+        if name in documented:
+            continue
+        rel, line = where[0]
+        findings.append(Finding(
+            rule="TS001", file=rel, line=line,
+            message=(
+                f"telemetry name {name!r} is emitted here but documented "
+                f"in none of {'/'.join(SCHEMA_DOCS)}"
+            ),
+            hint=(
+                "add a schema row for it in OBSERVABILITY.md (serve/fault "
+                "families may live in SERVE.md/FAULT.md)"
+            ),
+        ))
+
+    code_prefixes = {n.split("/", 1)[0] for n in used}
+    # names reaching an emitter through a variable (supervisor's
+    # failure-class counter, the health gauge table) still appear as
+    # quoted literals somewhere in the tree — that counts as emitted
+    def literal_in_code(name: str) -> bool:
+        dq, sq = f'"{name}"', f"'{name}'"
+        return any(dq in s.text or sq in s.text for s in repo.files.values())
+
+    for name, doc in sorted(documented.items()):
+        prefix = name.split("/", 1)[0]
+        if name in used or name in sites:
+            continue
+        if prefix not in code_prefixes or name.startswith("span/"):
+            continue  # dynamic family or a namespace code never emits
+        if literal_in_code(name):
+            continue
+        findings.append(Finding(
+            rule="TS002", file=doc, line=repo.doc_line(doc, f"`{name}`"),
+            message=(
+                f"documented telemetry name {name!r} is emitted nowhere "
+                "in code"
+            ),
+            hint=(
+                "drop (or un-backtick) the stale schema row, or restore "
+                "the emitter — a dashboard keyed on this name is flat"
+            ),
+        ))
+    return findings

@@ -1,0 +1,147 @@
+"""CS rules — the chaos-site registry vs the instrumented call sites.
+
+Chaos coverage is a closed loop: a site string fired through
+``fault.chaos.maybe_fire``/``site`` must be declared in
+``fault.chaos.CHAOS_SITES`` (so seeded plans and drills can target it by
+name) and documented in FAULT.md (so an operator reading a
+``fault/chaos_injected`` event knows what was hit).  A fired-but-
+undeclared site is untargetable chaos; a declared-but-unfired site is a
+drill aimed at nothing — both are silent coverage loss.  Rules:
+
+- **CS001** — a site fired in code but missing from ``CHAOS_SITES``.
+- **CS002** — a ``CHAOS_SITES`` row whose site is fired nowhere
+  (injector *defaults* inside ``fault/chaos.py`` don't count as firings
+  — only instrumented call sites in library code do).
+- **CS003** — a ``CHAOS_SITES`` row not mentioned in FAULT.md.
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import ast
+
+from tpuframe.lint.driver import Repo
+from tpuframe.lint.report import Finding
+
+RULES = {
+    "CS001": "chaos site fired in code but not declared in CHAOS_SITES",
+    "CS002": "CHAOS_SITES entry never fired by any instrumented call site",
+    "CS003": "CHAOS_SITES entry not documented in FAULT.md",
+}
+
+_FIRERS = ("maybe_fire", "site")
+
+
+def _chaos_module(repo: Repo) -> str | None:
+    for name in repo.files:
+        if name.endswith(".fault.chaos"):
+            return name
+    return None
+
+
+def declared_sites(repo: Repo) -> dict[str, int]:
+    """site -> declaration line, from the CHAOS_SITES dict literal."""
+    mod = _chaos_module(repo)
+    if mod is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(repo.files[mod].tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == "CHAOS_SITES"):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+def fired_sites(repo: Repo) -> dict[str, list[tuple[str, int]]]:
+    """site -> [(file, line)] for literal maybe_fire()/site() call sites
+    outside fault/chaos.py itself."""
+    chaos_mod = _chaos_module(repo)
+    out: dict[str, list[tuple[str, int]]] = {}
+    for src in repo.files.values():
+        if src.module == chaos_mod:
+            continue
+        # bare-name firer calls only count when this module actually
+        # imported the name from fault.chaos — an unrelated local
+        # `site(url)` helper must not register spurious chaos sites
+        imported_firers = {
+            a.asname or a.name
+            for node in src.nodes
+            if isinstance(node, ast.ImportFrom)
+            and (node.module or "").endswith("fault.chaos")
+            for a in node.names
+            if a.name in _FIRERS
+        }
+        for node in src.nodes:
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                # require the chaos-module receiver (chaos.site(...))
+                recv = func.value
+                if func.attr not in _FIRERS or not (
+                    isinstance(recv, ast.Name) and recv.id == "chaos"
+                ):
+                    continue
+            elif isinstance(func, ast.Name):
+                if func.id not in imported_firers:
+                    continue
+            else:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.setdefault(arg.value, []).append((src.rel, node.lineno))
+    return out
+
+
+def check(repo: Repo) -> list[Finding]:
+    chaos_mod = _chaos_module(repo)
+    if chaos_mod is None:
+        return []
+    chaos_src = repo.files[chaos_mod]
+    declared = declared_sites(repo)
+    fired = fired_sites(repo)
+    findings: list[Finding] = []
+
+    for site, where in sorted(fired.items()):
+        if site in declared:
+            continue
+        rel, line = where[0]
+        findings.append(Finding(
+            rule="CS001", file=rel, line=line,
+            message=(
+                f"chaos site {site!r} is fired here but not declared in "
+                "fault.chaos.CHAOS_SITES"
+            ),
+            hint=(
+                "add a CHAOS_SITES row (site -> where it instruments) and "
+                "a FAULT.md mention so drills can target it by name"
+            ),
+        ))
+
+    for site, line in sorted(declared.items()):
+        if site not in fired:
+            findings.append(Finding(
+                rule="CS002", file=chaos_src.rel, line=line,
+                message=(
+                    f"CHAOS_SITES declares {site!r} but no instrumented "
+                    "call site fires it"
+                ),
+                hint=(
+                    "instrument the code path with chaos.maybe_fire("
+                    f"{site!r}, ...) or delete the dead registry row"
+                ),
+            ))
+        if "FAULT.md" in repo.docs and site not in repo.docs["FAULT.md"]:
+            findings.append(Finding(
+                rule="CS003", file=chaos_src.rel, line=line,
+                message=f"chaos site {site!r} is not documented in FAULT.md",
+                hint="add it to FAULT.md's injector/site reference",
+            ))
+    return findings

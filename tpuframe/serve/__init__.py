@@ -18,6 +18,8 @@ header reader stay importable while the jax backend is wedged — the
 doctor and the remote launcher depend on that.
 """
 
+# tpuframe-lint: stdlib-only
+
 _LAZY = {
     "AdmissionController": "tpuframe.serve.admission",
     "ExportedModel": "tpuframe.serve.export",

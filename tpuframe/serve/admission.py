@@ -31,6 +31,8 @@ Pieces:
   backend (``serve.export.load_model`` reuses it).
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import collections

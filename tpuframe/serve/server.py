@@ -21,6 +21,8 @@ platform SIGTERM follows the graceful ladder: stop admitting, finish
 in-flight requests, flush telemetry, exit 0.
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import io

@@ -20,6 +20,8 @@ without dragging in the profiler's train-package (and therefore jax)
 imports.  ``from tpuframe.track import X`` works exactly as before.
 """
 
+# tpuframe-lint: stdlib-only
+
 import importlib
 
 # name -> submodule it lives in (all under tpuframe.track)

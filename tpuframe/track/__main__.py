@@ -10,6 +10,8 @@ Subcommands:
 Stdlib-only: analyzing a wedged fleet's logs must not need jax.
 """
 
+# tpuframe-lint: stdlib-only
+
 import sys
 
 

@@ -42,6 +42,8 @@ CLI: ``python -m tpuframe.track analyze <dir> [--trace out.json]
 (analyzing a wedged fleet's logs must not require a working backend).
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import argparse

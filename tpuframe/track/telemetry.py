@@ -52,6 +52,8 @@ analyzer (``tpuframe.track.analyze``) uses the anchors to place every
 rank's events on one timeline even when a rank's wall clock steps mid-run.
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import contextlib

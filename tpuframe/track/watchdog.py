@@ -25,6 +25,8 @@ table > ``default_deadline_s``; unresolved means unmonitored (guards are
 free to place unconditionally).  Stdlib-only, never imports jax.
 """
 
+# tpuframe-lint: stdlib-only
+
 from __future__ import annotations
 
 import contextlib

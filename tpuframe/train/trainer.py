@@ -458,7 +458,7 @@ class Trainer:
         """
         if self._intra_ck is None and self.checkpointer is not None:
             from tpuframe.ckpt import Checkpointer
-            from tpuframe.ckpt.checkpoint import latest_step
+            from tpuframe.ckpt.meta import latest_step
 
             intra_dir = str(self.checkpointer.directory) + "_intra"
             # Construct when the feature is on, OR when a previous run
