@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Wire-level collectives benchmark: bytes-on-wire + collective wall.
+
+Prices the compressed gradient allreduce
+(``tpuframe.parallel.compression``) against the exact f32 one at matched
+step semantics:
+
+- **bytes-on-wire** — the static per-step wire plan (ring model) for
+  f32 vs int8/int8-EF/fp8 over the same gradient tree; the committed
+  ``reduction_x`` is the headline EQuARX-style saving (int8 payloads ~4x
+  under f32, minus bucket padding + scale traffic).
+- **allreduce wall** — the standalone measured collective
+  (``make_compressed_pmean``: ``comms/allreduce`` spans,
+  ``comms/allreduce_s`` histogram) per mode, p50 over ``--iters`` calls.
+  On CPU the quantize/dequantize arithmetic *costs* wall (no DCN to
+  win back) — the honest number is the TPU one; ``capture_tpu_proofs.sh``
+  has the rung.
+- **step time** — a short matched A/B fit of the SAME model/batches
+  through ``make_train_step`` exact vs compressed (EF on), committed as
+  ``step_time_compressed`` (deliberately NOT a top-level ``step_time``
+  block: this record gates wire regressions via its ``comms`` block,
+  not the fleet step-time baseline).
+
+The committed record's ``comms`` block is what ``python -m
+tpuframe.track analyze --baseline benchmarks/results/`` ratios future
+runs against (``ratio_bytes_on_wire`` / ``ratio_allreduce_p50``,
+exit 3 on regression).
+
+Usage: python benchmarks/bench_collectives.py [--payload-mb 8]
+           [--iters 30] [--steps 30] [--json-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+
+def make_grad_tree(payload_mb: float, jnp, rng):
+    """A transformer-ish gradient pytree totaling ~payload_mb MiB of f32:
+    a few big matrices, several small vectors (the shape mix per-bucket
+    scales exist for)."""
+    total = int(payload_mb * (1 << 20) / 4)
+    big = max(256, int((total * 0.96) ** 0.5))
+    tree = {
+        "layer0/kernel": rng.standard_normal((big, big)) * 0.05,
+        "layer0/bias": rng.standard_normal((big,)) * 1e-3,
+        "layer1/kernel": rng.standard_normal((big, max(8, total // big - big))) * 2.0,
+        "layer1/bias": rng.standard_normal((max(8, total // big - big),)) * 1e-4,
+        "norm/scale": rng.standard_normal((big,)),
+    }
+    return {k: jnp.asarray(v, jnp.float32) for k, v in tree.items()}
+
+
+def time_collective(fn, tree, residual, iters: int) -> dict:
+    walls = []
+    out = None
+    for _ in range(max(3, iters)):
+        t0 = time.perf_counter()
+        out, residual = fn(tree, residual)
+        walls.append(time.perf_counter() - t0)
+    walls = sorted(walls[2:])  # drop compile + warmup
+    return {
+        "p50_s": round(statistics.median(walls), 6),
+        "min_s": round(walls[0], 6),
+        "iters": len(walls),
+    }, out
+
+
+def time_steps(step, state, batches) -> list[float]:
+    import jax
+
+    walls = []
+    for batch in batches:
+        t0 = time.perf_counter()
+        state, metrics = step(state, dict(batch))
+        jax.block_until_ready(metrics)
+        walls.append(time.perf_counter() - t0)
+    return walls
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--payload-mb", type=float, default=8.0)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--steps", type=int, default=30,
+                    help="matched A/B train steps per arm")
+    ap.add_argument("--bucket-mb", type=float, default=4.0)
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
+        "JAX_PLATFORMS" not in os.environ
+        and not os.environ.get("TPU_NAME")
+    ):
+        from tpuframe.core.runtime import simulate_cpu_devices
+
+        simulate_cpu_devices(8)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpuframe.core.runtime import MeshSpec, shard_map
+    from tpuframe.parallel import ParallelPlan
+    from tpuframe.parallel.compression import (
+        CommsConfig,
+        comms_template,
+        grad_layout,
+        init_comms_state,
+        make_compressed_pmean,
+        wire_plan,
+    )
+
+    world = len(jax.devices())
+    mesh = MeshSpec(data=world).build()
+    plan = ParallelPlan(mesh=mesh)
+    rng = np.random.default_rng(0)
+    tree = make_grad_tree(args.payload_mb, jnp, rng)
+    n_elems = sum(int(x.size) for x in jax.tree.leaves(tree))
+
+    rec: dict = {
+        "backend": jax.default_backend(),
+        "world": world,
+        "payload_mb": round(n_elems * 4 / (1 << 20), 3),
+        "modes": {},
+    }
+
+    # exact f32 pmean — the uncompressed control, same call shape
+    exact = jax.jit(shard_map(
+        lambda t: jax.tree.map(lambda g: jax.lax.pmean(g, ("data",)), t),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
+    ))
+    f32_wall, exact_out = time_collective(
+        lambda t, r: (exact(t), r), tree, {}, args.iters
+    )
+    base_layout = grad_layout(tree, CommsConfig(bucket_mb=args.bucket_mb), plan)
+    f32_bytes = wire_plan(
+        base_layout, CommsConfig(bucket_mb=args.bucket_mb)
+    )["f32_bytes_per_step"]
+    rec["modes"]["f32"] = {"bytes_per_step": f32_bytes, **f32_wall}
+
+    for mode, ef in (("int8", False), ("int8", True), ("fp8", True)):
+        name = f"{mode}_ef" if ef else mode
+        config = CommsConfig(
+            mode=mode, bucket_mb=args.bucket_mb, error_feedback=ef
+        )
+        residual = (
+            {
+                k: jnp.zeros(s, jnp.float32)
+                for k, s in comms_template(tree, config, plan).items()
+            }
+            if ef else {}
+        )
+        fn = make_compressed_pmean(plan, config)
+        wall, out = time_collective(fn, tree, residual, args.iters)
+        wp = wire_plan(grad_layout(tree, config, plan), config)
+        err = max(
+            float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(exact_out))
+        )
+        rec["modes"][name] = {
+            "bytes_per_step": wp["bytes_per_step"],
+            "reduction_x": wp["reduction_x"],
+            "n_buckets": wp["n_buckets"],
+            "max_abs_err_vs_f32": round(err, 8),
+            **wall,
+        }
+
+    int8_ef = rec["modes"]["int8_ef"]
+    rec["bytes_on_wire"] = {
+        "f32_bytes_per_step": f32_bytes,
+        "int8_ef_bytes_per_step": int8_ef["bytes_per_step"],
+        "reduction_x": round(f32_bytes / int8_ef["bytes_per_step"], 3),
+    }
+
+    # matched A/B step semantics: same model, same batches, exact vs
+    # compressed train step (EF on)
+    from flax import linen as nn
+
+    from tpuframe.train import create_train_state, make_train_step
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Dense(256)(x.reshape((x.shape[0], -1)))
+            x = nn.relu(x)
+            return nn.Dense(16)(x)
+
+    def mk_state(config=None):
+        s = create_train_state(
+            Net(), jax.random.PRNGKey(0),
+            jnp.ones((1, 16, 16, 1), jnp.float32), optax.adamw(1e-3),
+            plan=plan,
+        )
+        if config is not None:
+            s = s.replace(comms=init_comms_state(s.params, plan, config))
+        return s
+
+    def mk_batches(n):
+        r = np.random.default_rng(5)
+        out = []
+        for _ in range(n):
+            img = r.standard_normal((8 * world, 16, 16, 1)).astype(np.float32)
+            lab = r.integers(0, 16, 8 * world).astype(np.int32)
+            out.append(plan.shard_batch({"image": img, "label": lab}))
+        return out
+
+    batches = mk_batches(args.steps)
+    config = CommsConfig(mode="int8", bucket_mb=args.bucket_mb)
+    exact_walls = time_steps(make_train_step(plan=plan), mk_state(), batches)
+    comp_step = make_train_step(plan=plan, grad_compression=config)
+    comp_walls = time_steps(comp_step, mk_state(config), batches)
+    drop = 3  # compile + warmup
+    rec["step_time_compressed"] = {
+        "f32_p50_s": round(statistics.median(sorted(exact_walls[drop:])), 6),
+        "int8_ef_p50_s": round(statistics.median(sorted(comp_walls[drop:])), 6),
+        "steps": len(comp_walls) - drop,
+        "note": (
+            "CPU pays the quantize arithmetic with no DCN to win back; "
+            "the wire saving is the bytes_on_wire block, the wall story "
+            "is the TPU rung"
+        ),
+    }
+
+    # the analyzer-gateable block (ratio_bytes_on_wire / ratio_allreduce_p50)
+    rec["comms"] = {
+        "mode": "int8",
+        "error_feedback": True,
+        "bytes_per_step": int8_ef["bytes_per_step"],
+        "f32_bytes_per_step": f32_bytes,
+        "reduction_x": rec["bytes_on_wire"]["reduction_x"],
+        "allreduce_s": {"p50": int8_ef["p50_s"]},
+    }
+    print(json.dumps(rec, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

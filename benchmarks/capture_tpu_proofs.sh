@@ -180,6 +180,15 @@ run lint_selftest.json         120  python benchmarks/bench_lint.py
 # against (SERVE.md); cheap, rides with the fault/analyze pair
 run bench_serve.json           300  python benchmarks/bench_serve.py
 
+# wire-collectives rung: bytes-on-wire (static ring model, backend-
+# independent) + the MEASURED compressed-allreduce wall and matched A/B
+# step time on the real chip — the committed `comms` block is what
+# `track analyze --baseline` gates wire regressions against
+# (ratio_bytes_on_wire / ratio_allreduce_p50, exit 3); on the TPU host
+# this is where the int8 wire's 4x stops costing CPU quantize wall and
+# starts buying DCN
+run bench_collectives.json    300  python benchmarks/bench_collectives.py
+
 # compile-spine rung: cold vs warm-cache vs AOT-overlapped
 # time-to-first-step on the real chip — the committed
 # time_to_first_step block is what `track analyze --baseline` gates

@@ -132,6 +132,9 @@ def train_digits(args) -> float:
         optimizer="adamw",
         lr=warmup_cosine(2e-3, warmup_steps=len(lt), total_steps=steps),
         num_classes=10,
+        # convergence-parity gate for the wire-compression spine: the
+        # compressed recipe must clear the SAME --min-accuracy as f32
+        grad_compression=args.grad_compression,
         log_interval=0,
         eval_interval=args.eval_interval,
         callbacks=(
@@ -210,6 +213,7 @@ def train_cifar10(args) -> float:
         algorithms=[LabelSmoothing(0.1, num_classes=10)],
         precision="bf16" if rt.platform == "tpu" else "f32",
         num_classes=10,
+        grad_compression=args.grad_compression,
         log_interval=0,
         eval_interval=args.eval_interval,
         callbacks=(
@@ -302,6 +306,11 @@ def main() -> None:
     ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--eval-interval", type=int, default=5)
     ap.add_argument("--min-accuracy", type=float, default=None)
+    ap.add_argument("--grad-compression", choices=["int8", "fp8"],
+                    default=None,
+                    help="train over the compressed gradient wire "
+                    "(tpuframe.parallel.compression, error feedback on) "
+                    "— the convergence gate then proves wire parity")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workdir", default="/tmp/tpuframe_convergence")
     ap.add_argument("--data-npz", default=None,

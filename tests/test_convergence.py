@@ -87,6 +87,37 @@ def test_real_data_digits_full_trainer_accuracy(tmp_path):
     assert "ACCEPTED" in proc.stdout
 
 
+def test_real_data_digits_compressed_wire_same_gate(tmp_path):
+    """Convergence parity for the wire-compression spine at FULL recipe
+    scale: the digits run over the int8-EF compressed gradient wire must
+    clear the exact --min-accuracy threshold the committed f32 recipe
+    uses (the fast 6-epoch both-arms variant runs in tier-1:
+    tests/test_comms.py::test_digits_convergence_gate_compressed_matches_f32)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    script = os.path.join(
+        os.path.dirname(__file__), os.pardir, "examples",
+        "08_real_data_convergence.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, script, "--dataset", "digits", "--epochs", "25",
+         "--min-accuracy", "0.97", "--grad-compression", "int8",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"--- stdout ---\n{proc.stdout[-2000:]}\n--- stderr ---\n"
+        f"{proc.stderr[-3000:]}"
+    )
+    assert "ACCEPTED" in proc.stdout
+
+
 def test_transformer_lm_learns_deterministic_sequences():
     """Next-token accuracy >80% on affine token streams in 60 steps —
     the LM/attention/CE stack end to end, sharded over the mesh."""

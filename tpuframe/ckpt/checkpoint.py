@@ -61,10 +61,20 @@ _DATA_FIELDS = ("step", "params", "opt_state", "batch_stats", "rng")
 
 
 def _state_data(state: Any) -> dict:
-    """The serializable pytree of a TrainState (or pass dicts through)."""
+    """The serializable pytree of a TrainState (or pass dicts through).
+
+    ``comms`` (the wire-compression EF residual,
+    ``parallel.compression``) joins only when present: the residual is
+    deferred gradient mass and must survive a resume, but uncompressed
+    states keep the exact pre-comms checkpoint layout so old
+    checkpoints restore bidirectionally."""
     if isinstance(state, Mapping):
         return dict(state)
-    return {f: getattr(state, f) for f in _DATA_FIELDS}
+    data = {f: getattr(state, f) for f in _DATA_FIELDS}
+    comms = getattr(state, "comms", None)
+    if comms and jax.tree.leaves(comms):
+        data["comms"] = comms
+    return data
 
 
 # -- topology manifests -------------------------------------------------------
@@ -106,6 +116,86 @@ def topology_manifest(state: Any, plan: Any = None) -> dict | None:
     }
 
 
+def _comms_restore_action(template: dict, manifest: dict | None):
+    """How the saved EF residual (``comms``) maps onto the template:
+
+    - ``(None, {})`` — no special handling (no comms in the template, or
+      no manifest to compare against: trust the saved layout matches);
+    - ``("reset", {})`` — checkpoint has no residual, or its bucket
+      layout (trailing dims) changed: keep the template's zeros;
+    - ``("fold", saved)`` — same keys/bucket layout at a different world
+      size: restore at the saved shape and fold the leading per-shard
+      dim onto the target world (world-ratio-scaled group sums — the
+      mean deferred correction is what survives, see ``_fold_comms``).
+    """
+    if "comms" not in template or manifest is None:
+        return None, {}
+    saved = {
+        k.split("/", 1)[1]: rec
+        for k, rec in (manifest.get("leaves") or {}).items()
+        if k.startswith("comms/")
+    }
+    tmpl_shapes = {
+        k: tuple(int(d) for d in v.shape) for k, v in template["comms"].items()
+    }
+    saved_shapes = {k: tuple(rec["shape"]) for k, rec in saved.items()}
+    if saved_shapes == tmpl_shapes:
+        return None, {}
+    if not saved:
+        return "reset", {}
+    if set(saved_shapes) == set(tmpl_shapes) and all(
+        saved_shapes[k][1:] == tmpl_shapes[k][1:] for k in saved_shapes
+    ):
+        return "fold", saved
+    return "reset", {}
+
+
+def _target_mesh(abstract: Any):
+    """Mesh of the restore target (first mesh-sharded leaf wins)."""
+    for leaf in jax.tree.leaves(abstract):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if getattr(sharding, "spec", None) is not None and hasattr(mesh, "devices"):
+            return mesh
+    return None
+
+
+def _fold_comms(restored_comms: dict, template_comms: dict, tele,
+                *, step: int) -> dict:
+    """Fold a residual's leading per-shard dim onto the target world
+    size: old shard i's deferred quantization error lands on the
+    surviving shard that inherits its group (``np.array_split``
+    grouping; a grow spreads zeros onto the new shards).
+
+    The group-sums are scaled by ``to_world / from_world``: what EF
+    actually owes the trajectory is the *mean* correction
+    ``(1/W) * sum_i(resid_i)``, and the next compressed step divides by
+    the NEW world — so the folded totals must shrink/grow with W or the
+    first post-reshard step would inject the outstanding deficit
+    multiplied by the world ratio (for an even shrink this is exactly
+    the per-group mean)."""
+    out = {}
+    from_w = to_w = None
+    for key, arr in restored_comms.items():
+        target = template_comms[key]
+        host = np.asarray(jax.device_get(arr))
+        from_w, to_w = host.shape[0], int(target.shape[0])
+        groups = np.array_split(np.arange(from_w), to_w)
+        scale = np.float32(to_w / from_w)
+        folded = np.stack([
+            host[idx].sum(axis=0) * scale if len(idx)
+            else np.zeros(host.shape[1:], host.dtype)
+            for idx in groups
+        ])
+        out[key] = jax.device_put(folded, target.sharding)
+    tele.registry.counter("comms/ef_reshards").inc()
+    tele.event(
+        "comms/ef_reshard", step=step, from_world=from_w, to_world=to_w,
+        leaves=len(out),
+    )
+    return out
+
+
 def _target_topology(abstract: Any) -> dict | None:
     """Mesh axes/world of the restore *target*, read off the abstract
     template's shardings (the first mesh-sharded leaf wins — one state,
@@ -137,6 +227,11 @@ def _validate_manifest_compat(manifest: dict, abstract: Any) -> None:
     }
     mismatched = []
     for path, rec in (manifest.get("leaves") or {}).items():
+        if path.startswith("comms/"):
+            # EF residuals are per-shard state whose GLOBAL shape scales
+            # with the world size — a leading-dim mismatch is the normal
+            # shrink/grow case, folded by restore(), not a model change
+            continue
         leaf = current.get(path)
         if leaf is None or not hasattr(leaf, "shape"):
             continue
@@ -394,11 +489,39 @@ class Checkpointer:
                 f"under {self.directory}"
             )
         template = _state_data(state)
+        tele = get_telemetry()
+        manifest = read_manifest(self.directory, step)
+        # EF residual compatibility (parallel.compression): decide up
+        # front whether the saved ``comms`` restores as-is, folds onto a
+        # different world size, or resets — BEFORE the abstract is built
+        comms_action, saved_comms = _comms_restore_action(
+            template, manifest
+        )
+        if comms_action == "reset":
+            # keep the template's zero residuals; restore everything else
+            template = {k: v for k, v in template.items() if k != "comms"}
+            tele.event(
+                "comms/ef_reset", step=int(step),
+                reason="checkpoint has no matching EF residual "
+                       "(pre-compression history, or bucket layout changed)",
+            )
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         if plan is not None:
             abstract = _apply_plan_shardings(abstract, plan)
-        tele = get_telemetry()
-        manifest = read_manifest(self.directory, step)
+        if comms_action == "fold":
+            # request each residual at its SAVED global shape, replicated
+            # on the target mesh; fold the leading (per-shard) dim after
+            mesh = _target_mesh(abstract)
+            rep = (
+                jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+                if mesh is not None else None
+            )
+            abstract["comms"] = {
+                k: jax.ShapeDtypeStruct(
+                    tuple(rec["shape"]), np.dtype(rec["dtype"]), sharding=rep
+                )
+                for k, rec in saved_comms.items()
+            }
         target = _target_topology(abstract)
         resharding = bool(
             manifest
@@ -436,6 +559,10 @@ class Checkpointer:
         data = _rebuffer(data)
         if isinstance(state, Mapping):
             return dict(data), dict(extra.get("meta", {}))
+        if comms_action == "fold":
+            data["comms"] = _fold_comms(
+                data["comms"], state.comms, tele, step=int(step)
+            )
         return state.replace(**data), dict(extra.get("meta", {}))
 
     def maybe_restore(

@@ -310,6 +310,35 @@ def serve_section(export_path: str | None = None) -> dict:
     return out
 
 
+def comms_section() -> dict:
+    """State of the wire-compression spine
+    (``tpuframe.parallel.compression``): the resolved compression config
+    (env knobs applied — mode/buckets/stochastic/EF), the
+    ``TPUFRAME_COMMS_*`` env that is set, and the paste-ready
+    ``bench_collectives`` one-liner.  Stdlib-only reads
+    (``parallel.comms_env``) — works against a wedged backend, like the
+    serve/ckpt sections."""
+    import dataclasses
+
+    from tpuframe.parallel.comms_env import COMMS_ENV_VARS, CommsConfig
+
+    out: dict = {
+        "env": {
+            k: os.environ[k] for k in COMMS_ENV_VARS if k in os.environ
+        },
+        "bench": "python benchmarks/bench_collectives.py",
+    }
+    try:
+        config = CommsConfig.from_env()
+    except ValueError as e:  # typo'd mode: report it, don't crash the doctor
+        out["error"] = str(e)
+        return out
+    out["enabled"] = config is not None
+    if config is not None:
+        out["config"] = dataclasses.asdict(config)
+    return out
+
+
 def lint_section() -> dict:
     """State of the invariant linter (``tpuframe.lint``): the full pass
     run in-process over the installed tree — finding count per rule and
@@ -382,6 +411,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "ckpt": ckpt_section(ckpt_dir, devices.get("device_count")),
         "health": health_section(ckpt_dir),
         "serve": serve_section(export_path),
+        "comms": comms_section(),
         "lint": lint_section(),
         "env": {
             k: os.environ[k]

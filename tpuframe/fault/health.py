@@ -258,7 +258,7 @@ def init_health_state() -> dict:
 
 
 def health_verdict(loss, grads, hstate: Mapping[str, Any], step,
-                   policy: HealthPolicy):
+                   policy: HealthPolicy, grad_sq=None):
     """The traced per-step check: ONE fused reduction over the gradient
     pytree (sum of squares — non-finite anywhere surfaces as a
     non-finite total), loss finiteness, and the EWMA spike test.
@@ -278,10 +278,14 @@ def health_verdict(loss, grads, hstate: Mapping[str, Any], step,
     import jax.numpy as jnp
 
     loss = jnp.asarray(loss, jnp.float32)
-    grad_sq = sum(
-        jnp.sum(jnp.square(g.astype(jnp.float32)))
-        for g in jax.tree.leaves(grads)
-    )
+    if grad_sq is None:
+        grad_sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(grads)
+        )
+    # callers whose gradient tree is sharded (the compressed ZeRO step:
+    # each shard holds update slices) pass the globally-reduced grad_sq
+    # so the verdict is identical on every shard
     grad_norm = jnp.sqrt(grad_sq)
     finite = jnp.isfinite(loss) & jnp.isfinite(grad_sq)
     warmed = hstate["good_steps"] >= policy.warmup_steps

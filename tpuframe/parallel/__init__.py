@@ -1,71 +1,64 @@
-"""Parallelism: sharding plans (DP / ZeRO / TP), precision policies.
+"""Parallelism: sharding plans (DP / ZeRO / TP), precision policies,
+wire-level compressed collectives.
 
 TPU-native re-expression of the reference's parallelism inventory
 (SURVEY.md §2.2): DDP replication, DeepSpeed ZeRO stages, and tensor-parallel
 hooks, all as declarative shardings over the core mesh — XLA inserts the
-collectives the reference performed imperatively through NCCL.
+collectives the reference performed imperatively through NCCL.  The
+``compression`` module adds explicit bucketed int8/fp8 gradient
+collectives with error feedback where DCN bandwidth is the bill.
+
+Exports are lazy (PEP 562, like ``tpuframe.serve``): the comms knob
+registry (``comms_env.COMMS_ENV_VARS``) must stay importable without
+jax — ``launch.remote.all_env_vars()`` and the doctor read it from
+wedged-backend or jax-less processes.
 """
 
-from tpuframe.parallel.precision import (
-    Policy,
-    align_model_dtype,
-    bf16_compute,
-    full_precision,
-    get_policy,
-    pure_bf16,
-)
-from tpuframe.parallel.sharding import (
-    ParallelPlan,
-    Rule,
-    infer_shard_dim,
-    mesh_axes,
-    path_str,
-    spec_from_json,
-    spec_to_json,
-)
-from tpuframe.parallel.pipeline import (
-    PipelinedTransformerLM,
-    gpipe_spmd,
-    pipeline_param_spec,
-    stack_stage_params,
-)
-from tpuframe.parallel.compression import quantized_pmean
-from tpuframe.parallel.zero import (
-    ZeroConfig,
-    host_offload_sharding,
-    supports_host_offload,
-    zero_0,
-    zero_1,
-    zero_2,
-    zero_3,
-    zero_3_offload,
-)
+# tpuframe-lint: stdlib-only
 
-__all__ = [
-    "quantized_pmean",
-    "PipelinedTransformerLM",
-    "gpipe_spmd",
-    "pipeline_param_spec",
-    "stack_stage_params",
-    "Policy",
-    "align_model_dtype",
-    "bf16_compute",
-    "full_precision",
-    "get_policy",
-    "pure_bf16",
-    "ParallelPlan",
-    "Rule",
-    "infer_shard_dim",
-    "mesh_axes",
-    "path_str",
-    "spec_from_json",
-    "spec_to_json",
-    "ZeroConfig",
-    "host_offload_sharding",
-    "supports_host_offload",
-    "zero_0",
-    "zero_1",
-    "zero_2",
-    "zero_3",
-    "zero_3_offload",
-]
+_LAZY = {
+    "Policy": "tpuframe.parallel.precision",
+    "align_model_dtype": "tpuframe.parallel.precision",
+    "bf16_compute": "tpuframe.parallel.precision",
+    "full_precision": "tpuframe.parallel.precision",
+    "get_policy": "tpuframe.parallel.precision",
+    "pure_bf16": "tpuframe.parallel.precision",
+    "ParallelPlan": "tpuframe.parallel.sharding",
+    "Rule": "tpuframe.parallel.sharding",
+    "infer_shard_dim": "tpuframe.parallel.sharding",
+    "mesh_axes": "tpuframe.parallel.sharding",
+    "path_str": "tpuframe.parallel.sharding",
+    "spec_from_json": "tpuframe.parallel.sharding",
+    "spec_to_json": "tpuframe.parallel.sharding",
+    "PipelinedTransformerLM": "tpuframe.parallel.pipeline",
+    "gpipe_spmd": "tpuframe.parallel.pipeline",
+    "pipeline_param_spec": "tpuframe.parallel.pipeline",
+    "stack_stage_params": "tpuframe.parallel.pipeline",
+    "quantized_pmean": "tpuframe.parallel.compression",
+    "CommsConfig": "tpuframe.parallel.comms_env",
+    "COMMS_ENV_VARS": "tpuframe.parallel.comms_env",
+    "init_comms_state": "tpuframe.parallel.compression",
+    "make_compressed_pmean": "tpuframe.parallel.compression",
+    "ZeroConfig": "tpuframe.parallel.zero",
+    "host_offload_sharding": "tpuframe.parallel.zero",
+    "supports_host_offload": "tpuframe.parallel.zero",
+    "zero_0": "tpuframe.parallel.zero",
+    "zero_1": "tpuframe.parallel.zero",
+    "zero_2": "tpuframe.parallel.zero",
+    "zero_3": "tpuframe.parallel.zero",
+    "zero_3_offload": "tpuframe.parallel.zero",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'tpuframe.parallel' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

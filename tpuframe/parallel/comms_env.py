@@ -1,0 +1,120 @@
+"""Comms knob registry — the stdlib-only half of the compression spine.
+
+The wire-level collective configuration (``tpuframe.parallel.compression``)
+is env-tunable per fleet: every knob here ships to remote workers through
+``launch.remote.all_env_vars()`` and prints in the doctor's ``comms``
+section.  Kept jax-free (like ``serve.admission`` / ``core.workspace``)
+so the aggregator and the doctor can read the registry from a
+wedged-backend or jax-less process.
+
+Knob semantics (the one table, mirrored in OBSERVABILITY.md):
+
+- ``TPUFRAME_COMMS_COMPRESSION`` — gradient wire format: ``int8`` /
+  ``fp8`` (e4m3) / empty = off.  The ``Trainer(grad_compression=...)``
+  parameter overrides the env.
+- ``TPUFRAME_COMMS_BUCKET_MB`` — transport bucket size in MiB of f32
+  payload (default 4.0).  Leaves are flattened into a small number of
+  fixed-size buckets, each with its own quantization scale.
+- ``TPUFRAME_COMMS_STOCHASTIC`` — ``1`` enables stochastic rounding on
+  the int8 grid (unbiased; fp8 uses round-to-nearest-even in hardware,
+  the knob does not apply there).
+- ``TPUFRAME_COMMS_EF`` — error feedback on/off (default on): the
+  quantization residual is carried as a ``TrainState.comms`` leaf and
+  re-injected next step, so the compressed trajectory tracks f32.
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = ["COMMS_ENV_VARS", "CommsConfig", "COMPRESSION_MODES"]
+
+#: the comms spine's env knobs — aggregated by
+#: ``launch.remote.all_env_vars()`` and printed by the doctor
+COMMS_ENV_VARS = (
+    "TPUFRAME_COMMS_COMPRESSION",
+    "TPUFRAME_COMMS_BUCKET_MB",
+    "TPUFRAME_COMMS_STOCHASTIC",
+    "TPUFRAME_COMMS_EF",
+)
+
+#: wire formats the compressed collectives understand
+COMPRESSION_MODES = ("int8", "fp8")
+
+_FALSY = {"0", "false", "off", "no", ""}
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSY
+
+
+@dataclasses.dataclass(frozen=True)
+class CommsConfig:
+    """Resolved wire-compression policy for the gradient collectives.
+
+    ``mode`` is one of :data:`COMPRESSION_MODES`; construction validates
+    it so a typo'd env/param fails at build time, not mid-step.
+    """
+
+    mode: str = "int8"
+    bucket_mb: float = 4.0
+    stochastic_rounding: bool = False
+    error_feedback: bool = True
+
+    def __post_init__(self):
+        if self.mode not in COMPRESSION_MODES:
+            raise ValueError(
+                f"unknown grad_compression {self.mode!r}; known: "
+                + "/".join(COMPRESSION_MODES)
+            )
+        if self.bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be > 0, got {self.bucket_mb}")
+
+    @property
+    def bucket_elems(self) -> int:
+        """Max f32 elements per transport bucket."""
+        return max(64, int(self.bucket_mb * (1 << 20) / 4))
+
+    @property
+    def wire_bytes_per_elem(self) -> int:
+        """Payload bytes per element on the wire (int8 and fp8-e4m3 are
+        both one byte)."""
+        return 1
+
+    @classmethod
+    def from_env(cls, mode: str | None = None) -> "CommsConfig | None":
+        """The env-resolved config; ``mode`` (a Trainer/step parameter)
+        overrides ``TPUFRAME_COMMS_COMPRESSION``.  None = compression
+        off (no mode requested anywhere).  Malformed numeric/boolean
+        knobs fall back to defaults (tolerant, like ``ServeKnobs``); an
+        unknown *mode* still raises — silently training uncompressed
+        when compression was asked for is the one failure that must be
+        loud."""
+        if mode is None:
+            mode = os.environ.get("TPUFRAME_COMMS_COMPRESSION", "").strip()
+        if isinstance(mode, CommsConfig):
+            return mode
+        if not mode:
+            return None
+        return cls(
+            mode=str(mode).lower(),
+            bucket_mb=_env_float("TPUFRAME_COMMS_BUCKET_MB", 4.0),
+            stochastic_rounding=_env_bool("TPUFRAME_COMMS_STOCHASTIC", False),
+            error_feedback=_env_bool("TPUFRAME_COMMS_EF", True),
+        )

@@ -304,6 +304,32 @@ class ParallelPlan:
             spec = self._maybe_fsdp(shape, spec)
         return spec
 
+    def update_shard_specs(self, params: Any) -> dict[str, tuple]:
+        """The plan-derived weight-update sharding (arXiv:2004.13336,
+        mechanically from the data-parallel graph): for ZeRO stage 1/2,
+        every param leaf big enough to shard (``min_shard_elems``) with
+        a dimension divisible by the *combined* data-parallel world is
+        assigned ``{path: (dim, axes)}`` — the compressed train step
+        reduce-scatters its gradient along ``dim`` over ``axes``, runs
+        the optimizer on the owned slice against the plan's sharded
+        state, and all-gathers the update.  Leaves that don't qualify
+        (small, or no divisible dim) stay replicated and travel in the
+        shared transport buckets instead.
+        """
+        axes = tuple(a for a in self.data_axes if self.axis_size(a) > 1)
+        world = int(np.prod([self.axis_size(a) for a in axes])) if axes else 1
+        out: dict[str, tuple] = {}
+        if world <= 1 or self.zero_stage not in (1, 2):
+            return out
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            if not shape or int(np.prod(shape)) < self.min_shard_elems:
+                continue
+            dim = infer_shard_dim(shape, world)
+            if dim is not None:
+                out[path_str(path)] = (dim, axes)
+        return out
+
     def param_shardings(self, params: Any) -> Any:
         """Pytree of NamedSharding matching ``params`` (arrays or ShapeDtypeStructs)."""
 

@@ -536,6 +536,45 @@ def skew_report(ranks: Sequence[RankLog], *,
             "p95": round(_pctl(serve_lats, 0.95), 6),
             "p99": round(_pctl(serve_lats, 0.99), 6),
         }
+    # comms block: present only when the run declared a wire plan (the
+    # compressed train step emits one comms/wire_plan event at build).
+    # bytes_per_step is static per signature; the run total multiplies
+    # by the steps each rank dispatched.  allreduce_s quantiles appear
+    # when the run timed standalone compressed collectives
+    # (make_compressed_pmean / bench_collectives emit comms/allreduce
+    # spans) — fused train steps carry the collective inside the step
+    # program, so no per-collective wall exists to report there.
+    comms_info = None
+    wire_events = [
+        rec for rl in ranks for rec in rl.events
+        if rec.get("name") == "comms/wire_plan"
+    ]
+    if wire_events:
+        w = wire_events[-1]
+        steps_total = sum(len(rows) for rows in per_rank_rows.values())
+        ar_durs = sorted(
+            float(rec.get("dur_s", 0.0))
+            for rl in ranks for rec in rl.events
+            if rec.get("kind") == "span" and rec.get("name") == "comms/allreduce"
+        )
+        comms_info = {
+            "mode": w.get("mode"),
+            "world": w.get("world"),
+            "error_feedback": w.get("error_feedback"),
+            "bytes_per_step": w.get("bytes_per_step"),
+            "f32_bytes_per_step": w.get("f32_bytes_per_step"),
+            "reduction_x": w.get("reduction_x"),
+            "steps": steps_total,
+            "bytes_on_wire": (
+                (w.get("bytes_per_step") or 0) * steps_total
+            ),
+            "allreduce_s": {
+                "count": len(ar_durs),
+                "p50": round(_pctl(ar_durs, 0.50), 6),
+                "p95": round(_pctl(ar_durs, 0.95), 6),
+                "p99": round(_pctl(ar_durs, 0.99), 6),
+            } if ar_durs else None,
+        }
     worst = max(excess, key=lambda r: excess[r]) if excess else None
     # measured compile wall: the warmup skip exists because the first
     # step carries the compile — report WHAT it carried instead of
@@ -588,6 +627,7 @@ def skew_report(ranks: Sequence[RankLog], *,
         } if ttfs_vals else None,
         "health": health_info,
         "straggler_factor": straggler_factor,
+        "comms": comms_info,             # wire traffic (baseline diffs)
         "serve_latency": serve_latency,  # request path (baseline diffs)
         "step_time": step_time,          # dispatch-only (baseline diffs)
         "step_wall": {                   # boundary-to-boundary
@@ -655,6 +695,9 @@ def baseline_diff(report: dict, baseline: str, *,
     cur = report.get("step_time") or {}
     cur_ttfs = (report.get("time_to_first_step") or {}).get("s")
     cur_serve = (report.get("serve_latency") or {}).get("p99")
+    cur_comms = report.get("comms") or {}
+    cur_bytes = cur_comms.get("bytes_per_step")
+    cur_ar = (cur_comms.get("allreduce_s") or {}).get("p50")
     out: dict = {"threshold": threshold, "backend": backend,
                  "baselines": [], "regressions": []}
     for p in paths:
@@ -671,7 +714,11 @@ def baseline_diff(report: dict, baseline: str, *,
         tt = tt if isinstance(tt, dict) and tt.get("s") else None
         sv = rec.get("serve_latency")
         sv = sv if isinstance(sv, dict) and sv.get("p99") else None
-        if st is None and tt is None and sv is None:
+        cm = rec.get("comms")
+        cm = cm if isinstance(cm, dict) and (
+            cm.get("bytes_per_step") or (cm.get("allreduce_s") or {}).get("p50")
+        ) else None
+        if st is None and tt is None and sv is None and cm is None:
             continue
         if backend and rec.get("backend") and rec["backend"] != backend:
             continue
@@ -691,12 +738,38 @@ def baseline_diff(report: dict, baseline: str, *,
             entry["baseline_serve_p99_s"] = sv["p99"]
             entry["current_serve_p99_s"] = cur_serve
             entry["ratio_serve_p99"] = round(cur_serve / sv["p99"], 4)
+        if cm is not None:
+            # wire regressions gate like step-time ones: a compressed
+            # run that puts more bytes on the wire than its baseline
+            # (bucket layout ballooned, mode downgraded) or whose
+            # standalone collective wall grew past threshold exits 3.
+            # A run with NO comms block is incomparable, not a
+            # regression — every f32 run diffs against a results dir
+            # that also holds the comms record, and flagging those
+            # would make the gate useless; compression-off shows as
+            # the comms line missing from --report instead
+            base_bytes = cm.get("bytes_per_step")
+            if base_bytes and cur_bytes:
+                entry["baseline_bytes_per_step"] = base_bytes
+                entry["current_bytes_per_step"] = cur_bytes
+                entry["ratio_bytes_on_wire"] = round(cur_bytes / base_bytes, 4)
+            base_ar = (cm.get("allreduce_s") or {}).get("p50")
+            if base_ar and cur_ar:
+                entry["baseline_allreduce_p50_s"] = base_ar
+                entry["current_allreduce_p50_s"] = cur_ar
+                entry["ratio_allreduce_p50"] = round(cur_ar / base_ar, 4)
         out["baselines"].append(entry)
         if (entry.get("ratio_p50") and entry["ratio_p50"] > threshold) or (
             entry.get("ratio_ttfs") and entry["ratio_ttfs"] > threshold
         ) or (
             entry.get("ratio_serve_p99")
             and entry["ratio_serve_p99"] > threshold
+        ) or (
+            entry.get("ratio_bytes_on_wire")
+            and entry["ratio_bytes_on_wire"] > threshold
+        ) or (
+            entry.get("ratio_allreduce_p50")
+            and entry["ratio_allreduce_p50"] > threshold
         ):
             out["regressions"].append(entry)
     return out
@@ -750,6 +823,23 @@ def format_report(report: dict, diff: dict | None = None, *,
             f"  serve latency: p50={sv['p50'] * 1e3:.1f}ms "
             f"p95={sv['p95'] * 1e3:.1f}ms p99={sv['p99'] * 1e3:.1f}ms "
             f"over {sv['count']} served request(s)"
+        )
+    cm = report.get("comms") or {}
+    if cm:
+        red = (
+            f" ({cm['reduction_x']}x under f32)"
+            if cm.get("reduction_x") else ""
+        )
+        lines.append(
+            f"  comms: {cm.get('mode')} wire, "
+            f"{(cm.get('bytes_per_step') or 0) / 1e6:.3f} MB/step{red}, "
+            f"{(cm.get('bytes_on_wire') or 0) / 1e6:.1f} MB over "
+            f"{cm.get('steps', 0)} rank-step(s)"
+            + (
+                f", allreduce p50="
+                f"{cm['allreduce_s']['p50'] * 1e3:.2f}ms"
+                if cm.get("allreduce_s") else ""
+            )
         )
     lines.append(
         f"  time lost to stragglers: {report['straggler_lost_s']:.3f}s "
@@ -812,6 +902,18 @@ def format_report(report: dict, diff: dict | None = None, *,
                 parts.append(
                     f"ttfs {b['baseline_ttfs_s']:.3f}s -> "
                     f"{b['current_ttfs_s']:.3f}s (x{b['ratio_ttfs']:.2f})"
+                )
+            if b.get("ratio_bytes_on_wire") is not None:
+                parts.append(
+                    f"bytes/step {b['baseline_bytes_per_step'] / 1e6:.3f}MB -> "
+                    f"{b['current_bytes_per_step'] / 1e6:.3f}MB "
+                    f"(x{b['ratio_bytes_on_wire']:.2f})"
+                )
+            if b.get("ratio_allreduce_p50") is not None:
+                parts.append(
+                    f"allreduce_p50 {b['baseline_allreduce_p50_s'] * 1e3:.2f}ms -> "
+                    f"{b['current_allreduce_p50_s'] * 1e3:.2f}ms "
+                    f"(x{b['ratio_allreduce_p50']:.2f})"
                 )
             if b.get("ratio_serve_p99") is not None:
                 parts.append(

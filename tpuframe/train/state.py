@@ -55,6 +55,16 @@ class TrainState(flax.struct.PyTreeNode):
     #: (``ckpt._DATA_FIELDS``): a restore restarts the EWMA warmup on
     #: fresh ground, and pre-sentinel checkpoints stay restorable.
     health: Any = flax.struct.field(default_factory=dict)
+    #: wire-compression error-feedback residuals
+    #: (``tpuframe.parallel.compression.init_comms_state``): one
+    #: full-size quantization residual per data-parallel shard, carried
+    #: through the compressed train step (EF-SGD).  Empty dict when
+    #: gradient compression (or error feedback) is off.  Unlike
+    #: ``health``, this IS checkpointed when present — the residual is
+    #: accumulated gradient mass, and dropping it on resume would lose
+    #: exactly the updates EF was deferring; reshard-on-restore folds
+    #: it onto a different world size (``ckpt.checkpoint``).
+    comms: Any = flax.struct.field(default_factory=dict)
 
     def apply_gradients(self, grads: Any, **changes: Any) -> "TrainState":
         opt_state = self.opt_state

@@ -13,6 +13,7 @@ train/ exposes both levels).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Callable, Mapping
 
@@ -122,7 +123,9 @@ def _train_metrics(loss, logits, labels) -> dict:
 
 
 def _apply_with_health(state: TrainState, grads: Any, new_stats: Any,
-                       loss, metrics: dict, health):
+                       loss, metrics: dict, health, *,
+                       apply_fn: Callable | None = None, grad_sq=None,
+                       extra_state: dict | None = None):
     """The sentinel tail every train-step flavor shares
     (``tpuframe.fault.health``): one fused grad-norm/finiteness
     reduction + the EWMA spike test produce a scalar ``bad`` verdict,
@@ -132,6 +135,14 @@ def _apply_with_health(state: TrainState, grads: Any, new_stats: Any,
     step's metrics contributions are zeroed (a NaN loss_sum would
     poison the whole window sum); the health flags ride the metrics
     pytree to the host, which reads them at its window cadence.
+
+    ``apply_fn`` overrides the plain ``state.apply_gradients`` (the
+    compressed ZeRO step applies a sharded update + all-gather);
+    ``grad_sq`` supplies a pre-reduced global gradient square when the
+    gradient tree is sharded across the mesh (the verdict must be
+    identical on every shard); ``extra_state`` = ``{field: (old, new)}``
+    adds more state fields to the bad-step rollback (the EF residual —
+    a poisoned step's quantization error must not be committed).
     """
     from tpuframe.fault.health import health_verdict
 
@@ -143,19 +154,25 @@ def _apply_with_health(state: TrainState, grads: Any, new_stats: Any,
             "health=tpuframe.fault.health.init_health_state() to replace)"
         )
     bad, new_hstate, hmetrics = health_verdict(
-        loss, grads, hstate, state.step, health
+        loss, grads, hstate, state.step, health, grad_sq=grad_sq
     )
-    applied = state.apply_gradients(grads, batch_stats=new_stats)
+    if apply_fn is None:
+        applied = state.apply_gradients(grads, batch_stats=new_stats)
+    else:
+        applied = apply_fn(grads)
 
     def keep_old(old, new):
         return jax.tree.map(lambda o, n: jnp.where(bad, o, n), old, new)
 
-    new_state = applied.replace(
-        params=keep_old(state.params, applied.params),
-        opt_state=keep_old(state.opt_state, applied.opt_state),
-        batch_stats=keep_old(state.batch_stats, applied.batch_stats),
-        health=new_hstate,
-    )
+    changes = {
+        "params": keep_old(state.params, applied.params),
+        "opt_state": keep_old(state.opt_state, applied.opt_state),
+        "batch_stats": keep_old(state.batch_stats, applied.batch_stats),
+        "health": new_hstate,
+    }
+    for field, (old, new) in (extra_state or {}).items():
+        changes[field] = keep_old(old, new)
+    new_state = applied.replace(**changes)
     metrics = {
         k: jnp.where(bad, jnp.zeros_like(v), v) for k, v in metrics.items()
     }
@@ -221,15 +238,19 @@ def make_train_step(
     runs *inside* the jitted program (e.g. fused uint8 normalization:
     ship raw bytes over PCIe, normalize on-chip).
 
-    ``grad_compression="int8"`` swaps the implicit GSPMD gradient
-    all-reduce for an explicit int8-quantized mean (EQuARX-style, see
+    ``grad_compression="int8"``/``"fp8"`` (or a
+    :class:`~tpuframe.parallel.comms_env.CommsConfig`) swaps the
+    implicit GSPMD gradient all-reduce for an explicit bucketed,
+    error-feedback quantized mean (EQuARX-style, see
     :mod:`tpuframe.parallel.compression`) — ~4x fewer sync bytes where
-    DCN bandwidth bounds DP scaling.  Pure-DP plans only (ZeRO/TP
-    re-shard gradients and own their collectives).  BatchNorm: use the
-    models' PLAIN/sync BN — inside ``shard_map`` it sees only its shard,
-    i.e. shard-local statistics (torch-DDP semantics) fall out for free;
-    ``bn_stats="local"``/``bn_groups`` is the GSPMD-path emulation of
-    the same thing and would degenerate to per-sample groups here.
+    DCN bandwidth bounds DP scaling.  Composes with DP and ZeRO-1/2
+    plans (plan-derived compressed reduce-scatter -> sharded update ->
+    all-gather); ZeRO-3/TP re-shard the params themselves and refuse.
+    BatchNorm: use the models' PLAIN/sync BN — inside ``shard_map`` it
+    sees only its shard, i.e. shard-local statistics (torch-DDP
+    semantics) fall out for free; ``bn_stats="local"``/``bn_groups`` is
+    the GSPMD-path emulation of the same thing and would degenerate to
+    per-sample groups here.
 
     ``health`` (a :class:`tpuframe.fault.health.HealthPolicy`) arms the
     training-health sentinel: grad-norm/finiteness + EWMA loss-spike
@@ -273,99 +294,362 @@ def make_train_step(
     return _wrap_offload(jax.jit(step, donate_argnums=(0,) if donate else ()), plan)
 
 
+class _CompressedStep:
+    """Deferred-built compressed train step.
+
+    The shard_map in/out specs depend on the *state's* tree structure
+    (per-leaf update sharding, the EF residual layout), which a factory
+    can't know — so the program is built on the first call (or AOT
+    lower) from the state's shapes, then cached.  ``lower`` makes the
+    object a first-class citizen of the compile spine
+    (``precompile_call`` AOT-compiles it and dispatches straight to the
+    executable — zero recompiles with compression on)."""
+
+    def __init__(self, builder: Callable):
+        self._builder = builder
+        self._fn = None
+        #: static per-step wire accounting (``comms/wire_plan``), set at
+        #: build; the Trainer meters ``comms/bytes_on_wire`` from it
+        self.wire = None
+
+    def _ensure(self, state):
+        if self._fn is None:
+            self._fn, self.wire = self._builder(state)
+
+    def __call__(self, state, batch):
+        self._ensure(state)
+        return self._fn(state, batch)
+
+    def lower(self, state, batch):
+        self._ensure(state)
+        return self._fn.lower(state, batch)
+
+
 def _make_compressed_train_step(
     policy: Policy,
     loss_fn: LossFn,
     donate: bool,
     plan: ParallelPlan | None,
     batch_transform: Callable[[dict], dict] | None,
-    grad_compression: str,
+    grad_compression,
     health=None,
+    n_microbatches: int = 1,
 ):
-    """shard_map train step with explicit quantized gradient sync.
+    """shard_map train step with explicit bucketed, error-feedback
+    compressed gradient sync (:mod:`tpuframe.parallel.compression`).
 
-    Each data shard computes grads on its slice of the batch, the mean
-    crosses the wire as int8 (:func:`quantized_pmean`), and every shard
-    applies the identical update to its replicated params.  Metrics psum
-    exactly (they're tiny).
+    Each data shard computes grads on its slice of the batch (grad-accum
+    scans microbatches first and compresses ONCE per super-batch), the
+    mean crosses the wire as int8/fp8 buckets with per-bucket scales,
+    and:
+
+    - stage 0: every shard applies the identical update to its
+      replicated params;
+    - stage 1/2: plan-sharded leaves take a compressed reduce-scatter,
+      the optimizer updates only the owned slice against the plan's
+      sharded state, and the f32 update is all-gathered back (the
+      arXiv:2004.13336 pipeline, derived from
+      ``ParallelPlan.update_shard_specs``).
+
+    Metrics psum exactly (they're tiny).  Error feedback needs the
+    ``TrainState.comms`` residual (``init_comms_state``); a state
+    without one runs compressed-without-EF, loudly
+    (``comms/ef_inactive``).
     """
     from jax.sharding import PartitionSpec as P
 
-    from tpuframe.parallel.compression import quantized_pmean
+    from tpuframe.parallel.compression import (
+        CommsConfig,
+        comms_template,
+        grad_layout,
+        sync_gradients,
+        wire_plan,
+    )
+    from tpuframe.parallel.sharding import path_str
 
-    if grad_compression != "int8":
-        raise ValueError(
-            f"unknown grad_compression {grad_compression!r}; known: 'int8'"
-        )
+    config = CommsConfig.from_env(grad_compression)
+    assert config is not None  # caller checked grad_compression truthy
     if plan is None:
         raise ValueError("grad_compression needs a plan (its mesh and data axes)")
-    if plan.zero_stage != 0 or plan.rules:
+    if plan.zero_stage == 3 or plan.rules:
         raise ValueError(
-            "grad_compression is pure-DP only: ZeRO/TP re-shard gradients "
-            f"and own their collectives (got zero_stage={plan.zero_stage}, "
-            f"rules={bool(plan.rules)})"
+            "grad_compression composes with DP and ZeRO-1/2 (replicated "
+            "params, plan-sharded update); ZeRO-3/TP re-shard the params "
+            "themselves and own their collectives (got "
+            f"zero_stage={plan.zero_stage}, rules={bool(plan.rules)})"
+        )
+    if plan.offload_optimizer:
+        raise ValueError(
+            "grad_compression does not compose with offload_optimizer: the "
+            "compressed step's explicit collectives pin the optimizer "
+            "state layout on device"
         )
     mesh = plan.mesh
     data_axes = tuple(a for a in plan.data_axes if mesh.shape[a] > 1) or tuple(
         plan.data_axes[:1]
     )
 
-    def shard_step(state: TrainState, batch: Mapping[str, jax.Array]):
-        if batch_transform is not None:
-            batch = batch_transform(dict(batch))
-        rng = state.step_rng("dropout")
-        # decorrelate dropout across shards (params stay identical:
-        # the synced gradient is what updates them)
-        for ax in data_axes:
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+    def build(state: TrainState):
+        from tpuframe.track.telemetry import get_telemetry
 
-        def compute_loss(params):
-            losses, logits, new_stats, aux = _forward(
-                state, params, batch, policy, True, rng, loss_fn
+        layout = grad_layout(state.params, config, plan)
+        expected = comms_template(state.params, config, plan)
+        have = {
+            path_str(p): tuple(leaf.shape)
+            for p, leaf in jax.tree_util.tree_flatten_with_path(state.comms)[0]
+        }
+        ef = bool(expected) and bool(have)
+        if ef and have != {k: tuple(v) for k, v in expected.items()}:
+            raise ValueError(
+                "TrainState.comms does not match this plan/config's EF "
+                f"residual layout (have {have}, expected {expected}); "
+                "re-initialize it with parallel.compression."
+                "init_comms_state(params, plan, config)"
             )
-            return jnp.mean(losses) + aux, (jnp.mean(losses), logits, new_stats)
+        run_config = (
+            config if ef or not config.error_feedback
+            else dataclasses.replace(config, error_feedback=False)
+        )
+        wire = wire_plan(layout, run_config)
+        tele = get_telemetry()
+        if config.error_feedback and not ef:
+            tele.event(
+                "comms/ef_inactive",
+                reason="TrainState.comms is empty — init_comms_state() "
+                       "was never applied; running compressed without "
+                       "error feedback",
+            )
+        tele.event(
+            "comms/wire_plan",
+            zero_stage=plan.zero_stage,
+            error_feedback=ef,
+            n_microbatches=n_microbatches,
+            stochastic=run_config.stochastic_rounding,
+            **wire,
+        )
+        sliced_dims = {path: dim for path, _, _, dim in layout.sliced}
+        world = layout.world
 
-        (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
-        # equal shard batch sizes => mean of per-shard mean-grads is the
-        # global mean; the wire format is int8
-        grads = quantized_pmean(grads, data_axes)
-        # BN moments were computed shard-locally (torch-DDP semantics);
-        # average the *updated running stats* so the replicated state is
-        # deterministic rather than whichever shard's copy wins assembly
-        new_stats = jax.tree.map(
-            lambda s: jax.lax.pmean(s, data_axes)
-            if jnp.issubdtype(s.dtype, jnp.floating)
-            else s,
-            new_stats,
+        def shard_step(state: TrainState, batch: Mapping[str, jax.Array]):
+            rng = state.step_rng("dropout")
+            # decorrelate dropout across shards (params stay identical:
+            # the synced gradient is what updates them)
+            for ax in data_axes:
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+
+            if n_microbatches == 1:
+                b = batch_transform(dict(batch)) if batch_transform else batch
+
+                def compute_loss(params):
+                    losses, logits, new_stats, aux = _forward(
+                        state, params, b, policy, True, rng, loss_fn
+                    )
+                    return (
+                        jnp.mean(losses) + aux,
+                        (jnp.mean(losses), logits, new_stats),
+                    )
+
+                (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True
+                )(state.params)
+                metrics = _train_metrics(loss, logits, b["label"])
+            else:
+                # grad-accum composition: scan the microbatches, average
+                # the accumulated gradient, compress ONCE per super-batch
+                zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+
+                def micro(carry, scanned):
+                    mb, micro_idx = scanned
+                    if batch_transform is not None:
+                        mb = batch_transform(dict(mb))
+                    grads_acc, stats, acc_metrics = carry
+                    mb_rng = jax.random.fold_in(rng, micro_idx)
+
+                    def compute_loss(params):
+                        losses, logits, new_stats, aux = _forward(
+                            state.replace(batch_stats=stats),
+                            params, mb, policy, True, mb_rng, loss_fn,
+                        )
+                        data_loss = jnp.mean(losses)
+                        return data_loss + aux, (data_loss, logits, new_stats)
+
+                    (_, (mloss, logits, new_stats)), g = jax.value_and_grad(
+                        compute_loss, has_aux=True
+                    )(state.params)
+                    acc_metrics = jax.tree.map(
+                        jnp.add, acc_metrics,
+                        _train_metrics(mloss, logits, mb["label"]),
+                    )
+                    return (
+                        jax.tree.map(jnp.add, grads_acc, g),
+                        new_stats,
+                        acc_metrics,
+                    ), None
+
+                init_metrics = {
+                    "loss_sum": jnp.zeros(()),
+                    "correct": jnp.zeros(()),
+                    "count": jnp.zeros(()),
+                }
+                (grads, new_stats, metrics), _ = jax.lax.scan(
+                    micro,
+                    (zero_grads, state.batch_stats, init_metrics),
+                    (batch, jnp.arange(n_microbatches)),
+                )
+                grads = jax.tree.map(lambda g: g / n_microbatches, grads)
+                loss = metrics["loss_sum"] / jnp.maximum(metrics["count"], 1.0)
+
+            # -- the wire: bucketed compressed sync (+EF residual) --
+            srng = None
+            if run_config.stochastic_rounding:
+                srng = state.step_rng("comms")
+                for ax in data_axes:
+                    srng = jax.random.fold_in(srng, jax.lax.axis_index(ax))
+            synced, new_comms = sync_gradients(
+                grads, state.comms, layout, run_config, srng
+            )
+            # BN moments were computed shard-locally (torch-DDP
+            # semantics); average the *updated running stats* so the
+            # replicated state is deterministic rather than whichever
+            # shard's copy wins assembly
+            new_stats = jax.tree.map(
+                lambda s: jax.lax.pmean(s, data_axes)
+                if jnp.issubdtype(s.dtype, jnp.floating)
+                else s,
+                new_stats,
+            )
+            metrics = jax.tree.map(
+                lambda m: jax.lax.psum(m, data_axes), metrics
+            )
+            gloss = jax.lax.pmean(loss, data_axes)
+
+            if not sliced_dims:
+                # stage 0: identical full mean grads on every shard
+                if health is None:
+                    new_state = state.apply_gradients(
+                        synced, batch_stats=new_stats
+                    ).replace(comms=new_comms)
+                    return new_state, metrics
+                # the verdict must be identical on every shard (params
+                # are replicated and updated in lockstep): judge the
+                # GLOBAL mean loss — the grads are already synced
+                return _apply_with_health(
+                    state, synced, new_stats, gloss, metrics, health,
+                    extra_state={"comms": (state.comms, new_comms)},
+                )
+
+            # -- stage 1/2: sharded optimizer update over owned slices --
+            idx = jnp.int32(0)
+            for ax in layout.axes:
+                idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+
+            def slice_leaf(path, leaf):
+                dim = sliced_dims.get(path_str(path))
+                if dim is None:
+                    return leaf
+                chunk = leaf.shape[dim] // world
+                return jax.lax.dynamic_slice_in_dim(
+                    leaf, idx * chunk, chunk, axis=dim
+                )
+
+            def gather_leaf(path, leaf):
+                dim = sliced_dims.get(path_str(path))
+                if dim is None:
+                    return leaf
+                return jax.lax.all_gather(
+                    leaf, layout.axes, axis=dim, tiled=True
+                )
+
+            def zero_apply(grads_mixed):
+                # opt_state arrived SLICED (the step's in_specs shard it
+                # per update_shard_specs); update the owned slices, then
+                # all-gather the f32 *update* onto the replicated params
+                params_view = jax.tree_util.tree_map_with_path(
+                    slice_leaf, state.params
+                )
+                updates, new_opt = state.tx.update(
+                    grads_mixed, state.opt_state, params_view
+                )
+                full_updates = jax.tree_util.tree_map_with_path(
+                    gather_leaf, updates
+                )
+                new_params = optax.apply_updates(state.params, full_updates)
+                return state.replace(
+                    step=state.step + 1,
+                    params=new_params,
+                    opt_state=new_opt,
+                    batch_stats=new_stats,
+                )
+
+            # global grad norm: slices psum across shards, full leaves
+            # (identical everywhere) added once — same scalar on every
+            # shard, so the health verdict can't split the fleet
+            sliced_sq = sum(
+                jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                for p, leaf in jax.tree_util.tree_flatten_with_path(synced)[0]
+                if path_str(p) in sliced_dims
+            )
+            full_sq = sum(
+                jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                for p, leaf in jax.tree_util.tree_flatten_with_path(synced)[0]
+                if path_str(p) not in sliced_dims
+            )
+            grad_sq = jax.lax.psum(sliced_sq, layout.axes) + full_sq
+            if health is None:
+                return zero_apply(synced).replace(comms=new_comms), metrics
+            return _apply_with_health(
+                state, synced, new_stats, gloss, metrics, health,
+                apply_fn=zero_apply, grad_sq=grad_sq,
+                extra_state={"comms": (state.comms, new_comms)},
+            )
+
+        # -- specs: state fields replicated except the plan-sharded
+        # optimizer slices and the per-shard EF residuals --
+        param_shapes = {
+            path_str(p): tuple(leaf.shape)
+            for p, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]
+        }
+
+        def opt_spec(path: str, shape: tuple):
+            # longest param-path suffix match (mu/nu/EMA mirror params)
+            parts = path.split("/")
+            for start in range(len(parts)):
+                suffix = "/".join(parts[start:])
+                if suffix in param_shapes:
+                    dim = sliced_dims.get(suffix)
+                    if dim is not None and param_shapes[suffix] == tuple(shape):
+                        entries = [None] * len(shape)
+                        entries[dim] = layout.axes
+                        return P(*entries)
+                    return P()
+            return P()
+
+        def spec_assign(path, leaf):
+            field = path_str(path[:1])
+            rest = path_str(path[1:])
+            if field == "comms":
+                return P(layout.axes)
+            if field == "opt_state" and hasattr(leaf, "shape") and leaf.shape:
+                return opt_spec(rest, tuple(leaf.shape))
+            return P()
+
+        state_specs = jax.tree_util.tree_map_with_path(spec_assign, state)
+        batch_spec = P(data_axes)
+        if n_microbatches > 1:
+            batch_spec = P(None, *batch_spec)
+        mapped = shard_map(
+            shard_step,
+            mesh=mesh,
+            in_specs=(state_specs, batch_spec),
+            out_specs=(state_specs, P()),
+            check_vma=False,
         )
-        metrics = jax.tree.map(
-            lambda m: jax.lax.psum(m, data_axes),
-            _train_metrics(loss, logits, batch["label"]),
-        )
-        if health is None:
-            new_state = state.apply_gradients(grads, batch_stats=new_stats)
-            return new_state, metrics
-        # the verdict must be identical on every shard (params are
-        # replicated and updated in lockstep): judge the GLOBAL mean
-        # loss, not this shard's — the grads are already synced
-        return _apply_with_health(
-            state, grads, new_stats, jax.lax.pmean(loss, data_axes),
-            metrics, health,
+        return (
+            jax.jit(mapped, donate_argnums=(0,) if donate else ()),
+            wire,
         )
 
-    batch_spec = P(data_axes)
-    mapped = shard_map(
-        shard_step,
-        mesh=mesh,
-        in_specs=(P(), batch_spec),  # params/state replicated, batch split
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return _wrap_offload(
-        jax.jit(mapped, donate_argnums=(0,) if donate else ()), plan
-    )
+    return _wrap_offload(_CompressedStep(build), plan)
 
 
 def make_eval_step(
@@ -440,6 +724,7 @@ def make_grad_accum_step(
     plan: ParallelPlan | None = None,
     batch_transform: Callable[[dict], dict] | None = None,
     health=None,
+    grad_compression=None,
 ):
     """Gradient accumulation over leading-dim microbatches via ``lax.scan``.
 
@@ -447,8 +732,19 @@ def make_grad_accum_step(
     averaged across microbatches; BN stats roll forward through the scan.
     Replaces DeepSpeed's ``gradient_accumulation_steps: auto``
     (`/root/reference/02_deepspeed/deepspeed_config.py:17`).
+
+    ``grad_compression`` composes: the scan accumulates the super-batch
+    gradient first and the compressed sync runs ONCE per optimizer step
+    (not per micro-step) — see :func:`_make_compressed_train_step`.
     """
     policy = policy or full_precision()
+    if grad_compression is not None:
+        # the step body runs inside shard_map there: the loss must stay
+        # unbound (mesh=None), same as make_train_step's compressed path
+        return _make_compressed_train_step(
+            policy, loss_fn, donate, plan, batch_transform,
+            grad_compression, health, n_microbatches,
+        )
     loss_fn = _bind_loss(loss_fn, plan)
 
     def step(state: TrainState, batch: Mapping[str, jax.Array]):
