@@ -172,6 +172,14 @@ run analyze_selftest.json      300  python benchmarks/bench_analyze.py
 # and the doctor/tier-1 budget depends on it staying that way
 run lint_selftest.json         120  python benchmarks/bench_lint.py
 
+# self-tuning rung: mis-configured -> diagnosed -> probe-converged on
+# the real chip's loader, persisting the winning config to this host's
+# store (AUTOTUNE.md) — the committed convergence ratio is the proof
+# the analyzer->knob loop closes without a human; rides with the
+# analyze/lint pair because the probes are short timeboxed fits
+TPUFRAME_AUTOTUNE=1 \
+run bench_autotune.json        300  python benchmarks/bench_autotune.py --json
+
 # serving rung: closed-loop throughput-vs-latency sweep + the seeded
 # QueueFlood overload run over the real ServeEngine (bucketed dynamic
 # batching, AOT-precompiled shapes) — on the TPU host this prices the

@@ -4,6 +4,11 @@ LAUNCH_CONTRACT_ENV_VARS = (  # tpuframe-lint: not-shipped
     "TPUFRAME_PROCESS_ID",
 )
 
+LAUNCH_CONTRACT_ENV_DOMAINS = {
+    "TPUFRAME_PROCESS_ID": {"type": "int", "range": (0, None),
+                            "apply": "restart"},
+}
+
 
 def all_env_vars():
     from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS

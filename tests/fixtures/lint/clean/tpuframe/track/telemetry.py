@@ -8,6 +8,10 @@ OBSERVABILITY_ENV_VARS = (
     "TPUFRAME_TELEMETRY_DIR",
 )
 
+OBSERVABILITY_ENV_DOMAINS = {
+    "TPUFRAME_TELEMETRY_DIR": {"type": "path", "apply": "restart"},
+}
+
 
 def telemetry_dir():
     return os.environ.get("TPUFRAME_TELEMETRY_DIR", "")

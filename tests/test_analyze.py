@@ -172,6 +172,60 @@ class TestSkewReport:
         assert "input" in text and "checkpoint" in text
 
 
+# -- skew_report as a stable library API --------------------------------------
+
+
+class TestSkewReportContract:
+    """Structural golden test: `skew_report`'s dict IS the API the
+    autotuner diagnoses from (`tpuframe.autotune.diagnosis`) and the
+    baseline differ gates on.  A silent analyzer refactor that drops or
+    renames a key must fail here, next to the contract constants it
+    must update (`SKEW_REPORT_VERSION` + the key tuples in analyze.py),
+    not three modules downstream in a tuning run."""
+
+    @pytest.fixture(scope="class")
+    def report(self):
+        return A.skew_report(A.load_dir(FIXTURE))
+
+    def test_top_level_keys_exactly_pin_the_contract(self, report):
+        assert set(report) == set(A.SKEW_REPORT_KEYS)
+        assert report["schema_version"] == A.SKEW_REPORT_VERSION
+
+    def test_per_rank_rows_pin_their_columns(self, report):
+        assert report["per_rank"], "golden fixture must produce rank rows"
+        for row in report["per_rank"]:
+            assert set(row) == set(A.SKEW_REPORT_PER_RANK_KEYS)
+
+    def test_per_step_rows_pin_their_columns(self, report):
+        assert report["per_step"], "golden fixture must produce step rows"
+        for row in report["per_step"]:
+            assert set(row) == set(A.SKEW_REPORT_PER_STEP_KEYS)
+
+    def test_lost_by_bound_carries_every_class(self, report):
+        assert set(report["lost_by_bound"]) == set(A.SKEW_REPORT_BOUNDS)
+
+    def test_distribution_blocks_have_percentiles(self, report):
+        # step_time/step_wall shapes the autotuner reads as baselines
+        assert {"count", "mean", "p50", "p95", "p99"} <= set(
+            report["step_time"]
+        )
+        assert {"p50", "p95"} <= set(report["step_wall"])
+
+    def test_empty_fleet_still_honours_the_contract(self):
+        report = A.skew_report([])
+        assert set(report) == set(A.SKEW_REPORT_KEYS)
+        assert report["ranks"] == 0 and report["per_step"] == []
+
+    def test_diagnosis_consumes_the_golden_report(self, report):
+        """The downstream contract in one hop: the autotuner's diagnose()
+        must read this exact report shape without error and land on a
+        real bound class."""
+        from tpuframe.autotune.diagnosis import diagnose
+
+        diag = diagnose(report)
+        assert diag.bound in set(A.SKEW_REPORT_BOUNDS) | {"comms", "none"}
+
+
 # -- Perfetto trace -----------------------------------------------------------
 
 

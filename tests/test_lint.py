@@ -60,7 +60,7 @@ def test_repo_tree_has_zero_findings():
     )
     # and the pass actually looked at the tree
     assert result.files_scanned > 50
-    assert result.rules_run == 16
+    assert result.rules_run == 17
 
 
 def test_seeded_violation_in_real_module_flips_red(tmp_path):
@@ -97,7 +97,7 @@ def dirty():
 def test_dirty_fixture_fires_every_rule_family(dirty):
     assert _rules(dirty) == {
         "JF001", "JF002",
-        "KN001", "KN002", "KN003", "KN004", "KN005", "KN006",
+        "KN001", "KN002", "KN003", "KN004", "KN005", "KN006", "KN007",
         "TS001", "TS002",
         "CS001", "CS002", "CS003",
         "HP001", "HP002", "HP003",
@@ -121,6 +121,54 @@ def test_knob_rules_name_the_right_knobs(dirty):
     assert {f.message.split("'")[1] for f in by["KN005"]} == {
         "TPUFRAME_DUP", "TPUFRAME_DEAD",
     }
+
+
+def test_domain_rule_fires_on_undomained_lists(dirty):
+    """The dirty fixture's knob lists carry no *_ENV_DOMAINS siblings —
+    every one of them is a KN007 missing-domain finding."""
+    by = _by_rule(dirty)
+    assert any("_ENV_DOMAINS" in f.message for f in by["KN007"])
+
+
+def test_domain_rule_entry_granularity(tmp_path):
+    """KN007 at entry level: a knob without an entry, an invalid entry,
+    and a stale entry for an undeclared knob each fire individually."""
+    pkg = _clean_copy(tmp_path)
+    (pkg / "spine.py").write_text(
+        "import os\n"
+        "S_ENV_VARS = (  # tpuframe-lint: not-shipped\n"
+        "    'TPUFRAME_S_A', 'TPUFRAME_S_B',\n"
+        ")\n"
+        "S_ENV_DOMAINS = {\n"
+        "    'TPUFRAME_S_A': {'type': 'int'},\n"  # no apply -> invalid
+        # TPUFRAME_S_B has no entry at all
+        "    'TPUFRAME_S_GONE': {'type': 'bool', 'apply': 'live'},\n"
+        "}\n"
+        "def reads():\n"
+        "    return (os.environ.get('TPUFRAME_S_A'),\n"
+        "            os.environ.get('TPUFRAME_S_B'))\n"
+    )
+    result = run_lint(str(pkg), str(tmp_path))
+    msgs = [f.message for f in result.findings if f.rule == "KN007"]
+    assert any("TPUFRAME_S_B" in m and "no entry" in m for m in msgs)
+    assert any("TPUFRAME_S_A" in m and "invalid" in m for m in msgs)
+    assert any("TPUFRAME_S_GONE" in m and "stale" in m for m in msgs)
+
+
+def test_real_tree_domains_cover_every_knob():
+    """The autotuner's contract: every declared knob on the real tree
+    carries a valid domain (type + apply, range/choices where typed),
+    and the inventory exposes it."""
+    rows = knob_inventory(load_repo(REAL_PKG, REPO_ROOT))
+    missing = [r["name"] for r in rows if r["lists"] and not r["domain"]]
+    assert not missing
+    by_name = {r["name"]: r for r in rows}
+    ga = by_name["TPUFRAME_GRAD_ACCUM"]["domain"]
+    assert ga["type"] == "int" and ga["apply"] == "restart"
+    dt = by_name["TPUFRAME_LOADER_TRANSFER_DTYPE"]["domain"]
+    assert tuple(dt["choices"]) == ("uint8", "float32")
+    guard = by_name["TPUFRAME_AUTOTUNE_GUARD"]["domain"]
+    assert guard["apply"] == "live" and tuple(guard["range"]) == (0.5, 1.0)
 
 
 def test_schema_rules_fire_both_directions(dirty):

@@ -1,0 +1,52 @@
+"""Self-tuning loop: the analyzer's diagnosis drives the knobs.
+
+The stack measures everything — bound classification per step, compile
+walls, bytes-on-wire, serve latency percentiles — and this package
+closes the loop: :func:`diagnose` turns a skew report into ordered knob
+moves, :func:`tune_training` A/B-probes them with the bench harness's
+warmup-discarded-median methodology under a never-commit-slower guard,
+and the winning config persists per ``(host, topology,
+plan.signature())`` next to the compile cache so supervised restarts
+and same-host ranks start tuned.  :func:`derive_serve_knobs` runs the
+same idea on the serve side from the observed request-size distribution
+against the SLO.  AUTOTUNE.md is the runbook.
+
+Exports are lazy (PEP 562): the knob list / domains / persistence store
+stay importable while the jax backend is wedged — ``all_env_vars()``
+and the doctor depend on that.
+"""
+
+# tpuframe-lint: stdlib-only
+
+_LAZY = {
+    "AUTOTUNE_ENV_VARS": "tpuframe.autotune.config",
+    "AUTOTUNE_ENV_DOMAINS": "tpuframe.autotune.config",
+    "Diagnosis": "tpuframe.autotune.diagnosis",
+    "KnobMove": "tpuframe.autotune.diagnosis",
+    "ProbeResult": "tpuframe.autotune.probe",
+    "TunedConfig": "tpuframe.autotune.config",
+    "all_env_domains": "tpuframe.autotune.config",
+    "autotune_dir": "tpuframe.autotune.config",
+    "autotune_enabled": "tpuframe.autotune.config",
+    "derive_serve_knobs": "tpuframe.autotune.tuner",
+    "diagnose": "tpuframe.autotune.diagnosis",
+    "list_tuned": "tpuframe.autotune.config",
+    "load_tuned": "tpuframe.autotune.config",
+    "run_probe": "tpuframe.autotune.probe",
+    "save_tuned": "tpuframe.autotune.config",
+    "tune_training": "tpuframe.autotune.tuner",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'tpuframe.autotune' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

@@ -1,0 +1,65 @@
+"""CLI: ``python -m tpuframe.autotune [--json] [--signature SIG]``.
+
+Lists the persisted winning configs in the store (all of them, or the
+one matching ``--host/--topology/--signature``) — the same view the
+doctor's ``autotune`` section prints.  Read-only: tuning itself runs
+where the workload lives (``tune_training`` needs a run_fn; see
+AUTOTUNE.md for the probe one-liner).
+"""
+
+# tpuframe-lint: stdlib-only
+
+import argparse
+import json
+
+from tpuframe.autotune.config import (
+    autotune_dir,
+    default_host,
+    list_tuned,
+    load_tuned,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuframe.autotune",
+        description="inspect the persisted autotune winning configs",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--dir", default=None, help="store dir (default: "
+                    "TPUFRAME_AUTOTUNE_DIR or the scratch sibling of the "
+                    "compile cache)")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--topology", default=None)
+    ap.add_argument("--signature", default=None,
+                    help="plan signature to look up (with --host/--topology "
+                         "defaults: this host, topology '1')")
+    args = ap.parse_args(argv)
+
+    if args.signature is not None:
+        cfg = load_tuned(args.host or default_host(),
+                         args.topology or "1", args.signature, args.dir)
+        if cfg is None:
+            print("no persisted config for that (host, topology, signature)")
+            return 1
+        print(json.dumps(cfg.to_dict(), indent=2, sort_keys=True))
+        return 0
+
+    configs = list_tuned(args.dir)
+    if args.as_json:
+        print(json.dumps({"dir": args.dir or autotune_dir(),
+                          "configs": [c.to_dict() for c in configs]},
+                         indent=2, sort_keys=True))
+        return 0
+    print(f"store: {args.dir or autotune_dir()}")
+    for c in configs:
+        ratio = c.convergence_ratio
+        print(f"  {c.source} host={c.host} topology={c.topology} "
+              f"signature={c.signature or '-'} knobs={len(c.env)}"
+              + (f" p50 x{ratio:.2f}" if ratio else ""))
+    print(f"{len(configs)} config(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,187 @@
+"""Diagnosis: turn the analyzer's skew report into ordered knob moves.
+
+The decision table (AUTOTUNE.md mirrors it) reads the same signals a
+human reads off ``python -m tpuframe.track.analyze``:
+
+- **input-bound** (``lost_by_bound.input`` dominates, or — single-rank
+  runs, where cross-rank skew is zero by construction — the per-step
+  ``bound`` votes / ``data_wait_total_s`` fraction say the step waits on
+  the host pipeline): more loader workers, deeper prefetch, more ring
+  buffers, uint8 transfer.
+- **checkpoint-bound** (``lost_by_bound.checkpoint`` dominates): stretch
+  the mid-epoch snapshot cadence.
+- **comms-bound** (the ``comms`` block shows allreduce wall a large
+  fraction of step wall at mode "none"): int8 wire compression, then
+  bucket sizing.
+- **compile** (cold-compile wall dominates total): make sure the AOT
+  precompiler and the persistent compile cache are on.
+
+Every proposed value passes through :func:`tpuframe.autotune.config.clamp`
+against the lint-enforced ``*_ENV_DOMAINS`` registry — a move outside a
+knob's legal domain is dropped here, before it can reach a probe.  The
+diagnosis only *proposes*; the probe harness decides (a committed move
+must beat its baseline, so a wrong diagnosis costs probe time, never a
+slower run).
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpuframe.autotune.config import all_env_domains, clamp
+
+__all__ = ["Diagnosis", "KnobMove", "diagnose"]
+
+#: below this fraction of total step wall, a bottleneck class is noise
+_SIGNIFICANT = 0.10
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobMove:
+    """One candidate env write: knob -> value, with the symptom that
+    motivated it (the doctor prints these as the decision trail)."""
+
+    knob: str
+    value: str
+    reason: str
+
+
+@dataclasses.dataclass
+class Diagnosis:
+    """What the report says is slow, and the ordered probe candidates."""
+
+    bound: str  # "input" | "checkpoint" | "comms" | "compute" | "none"
+    detail: dict
+    moves: list[KnobMove]
+
+
+def _bound_votes(report: dict) -> dict[str, int]:
+    """Per-step bound classification tally — the single-rank-safe signal
+    (``lost_by_bound`` only accumulates on straggling steps, which need
+    cross-rank skew to exist)."""
+    votes: dict[str, int] = {}
+    for row in report.get("per_step") or []:
+        b = row.get("bound")
+        if b:
+            votes[b] = votes.get(b, 0) + 1
+    return votes
+
+
+def _data_wait_fraction(report: dict) -> float:
+    """Fleet data-wait seconds over fleet step seconds — how much of the
+    run the devices spent waiting on the host pipeline."""
+    wait = sum(r.get("data_wait_total_s") or 0.0
+               for r in report.get("per_rank") or [])
+    st = report.get("step_time") or {}
+    total = (st.get("mean") or 0.0) * (st.get("count") or 0)
+    n_ranks = max(1, report.get("ranks") or 1)
+    return wait / (total * n_ranks) if total > 0 else 0.0
+
+
+def _classify(report: dict) -> tuple[str, dict]:
+    st = report.get("step_time") or {}
+    total_step_s = (st.get("mean") or 0.0) * (st.get("count") or 0)
+    lost = dict(report.get("lost_by_bound") or {})
+    votes = _bound_votes(report)
+    wait_frac = _data_wait_fraction(report)
+    detail = {
+        "lost_by_bound": lost,
+        "bound_votes": votes,
+        "data_wait_fraction": round(wait_frac, 4),
+    }
+
+    # multi-rank: straggler-attributed lost seconds name the bound
+    if total_step_s > 0 and lost:
+        top = max(lost, key=lambda k: lost[k])
+        if lost[top] / total_step_s >= _SIGNIFICANT:
+            return top, detail
+
+    # comms: allreduce wall as a fraction of step wall.  The report's
+    # allreduce_s is a percentile block (standalone/bench collectives
+    # only) — p50 x count approximates the total collective wall.
+    comms = report.get("comms") or None
+    if comms and total_step_s > 0:
+        ar = comms.get("allreduce_s") or 0.0
+        if isinstance(ar, dict):
+            ar = (ar.get("p50") or 0.0) * (ar.get("count") or 0)
+        frac = float(ar) / total_step_s
+        detail["comms_fraction"] = round(frac, 4)
+        if frac >= _SIGNIFICANT:
+            return "comms", detail
+
+    # single-rank fallback: the device waiting on the host IS input-bound
+    # even though no step ever "straggles"
+    if wait_frac >= _SIGNIFICANT:
+        return "input", detail
+    steps = sum(votes.values())
+    if steps:
+        top = max(votes, key=lambda k: votes[k])
+        if top != "compute" and votes[top] / steps >= 0.5:
+            return top, detail
+    return ("compute", detail) if steps else ("none", detail)
+
+
+def diagnose(report: dict, *, gauges: dict | None = None) -> Diagnosis:
+    """Ordered, domain-clamped knob moves for ``report``'s bottleneck.
+
+    ``gauges`` (optional) is a snapshot of live registry gauges (name ->
+    value) — currently consulted for the loader's ring-alloc pressure
+    (``data/ring_allocs`` growing means the pool is undersized).
+    """
+    domains = all_env_domains()
+    bound, detail = _classify(report)
+    moves: list[KnobMove] = []
+
+    def move(knob: str, value, reason: str) -> None:
+        v = clamp(knob, value, domains)
+        if v is not None:
+            moves.append(KnobMove(knob=knob, value=v, reason=reason))
+
+    if bound == "input":
+        why = (f"input-bound: data_wait {detail['data_wait_fraction']:.0%} "
+               "of step wall")
+        move("TPUFRAME_LOADER_WORKERS", 2, why)
+        move("TPUFRAME_LOADER_WORKERS", 4, why)
+        move("TPUFRAME_PREFETCH_DEPTH", 4, why)
+        move("TPUFRAME_LOADER_TRANSFER_DTYPE", "uint8",
+             "input-bound: uint8 transfer is 4x less host->device bytes")
+        move("TPUFRAME_LOADER_RING_BUFFERS", 8,
+             "input-bound: deeper assembly ring")
+        if gauges and (gauges.get("data/ring_allocs") or 0) > 0:
+            move("TPUFRAME_LOADER_RING_BUFFERS", 16,
+                 "ring pool undersized: data/ring_allocs still growing")
+    elif bound == "checkpoint":
+        lost = detail["lost_by_bound"].get("checkpoint", 0.0)
+        move("TPUFRAME_CKPT_INTERVAL_BATCHES", 200,
+             f"checkpoint-bound: {lost:.2f}s lost to snapshot stalls — "
+             "stretch the mid-epoch cadence")
+    elif bound == "comms":
+        comms = report.get("comms") or {}
+        if (comms.get("mode") or "none") in ("none", ""):
+            move("TPUFRAME_COMMS_COMPRESSION", "int8",
+                 "comms-bound at f32 wire: int8 is ~4x fewer sync bytes")
+        move("TPUFRAME_COMMS_BUCKET_MB", 8.0,
+             "comms-bound: larger buckets amortize per-collective latency")
+        move("TPUFRAME_GRAD_ACCUM", 2,
+             "comms-bound: accumulate micro-batches, sync once per "
+             "super-batch")
+    elif bound == "compute":
+        # compute-bound is the healthy state; the one knob worth probing
+        # is grad-accum DOWN if someone left it high (covered by restart
+        # config, not a live move) — nothing to do here.
+        pass
+
+    # compile block rides along regardless of bound: a cold compile that
+    # dominates the window says the cache/precompiler are off
+    compile_block = report.get("compile") or {}
+    ttfs = report.get("time_to_first_step") or {}
+    if (compile_block.get("wall_s") or 0.0) > 0 and (
+        ttfs.get("s") or 0.0
+    ) > 0 and compile_block["wall_s"] >= 0.5 * ttfs["s"]:
+        move("TPUFRAME_PRECOMPILE", True,
+             "compile wall dominates time-to-first-step: keep AOT "
+             "precompile on")
+
+    return Diagnosis(bound=bound, detail=detail, moves=moves)

@@ -1,0 +1,153 @@
+"""The tuning loops: greedy train-side probe search and the serve-side
+distribution-derived knobs.
+
+Train side (:func:`tune_training`): diagnose -> probe candidates in
+order -> each committed winner becomes the new baseline (and its env
+sticks for the remaining probes, so moves compose) -> persist the
+accumulated winning config per ``(host, topology, signature)``.  The
+whole loop is bounded by ``TPUFRAME_AUTOTUNE_ROUNDS`` probes; with the
+guard capped at 1.0 the tuned config is monotonically no-slower than
+the starting point by construction.
+
+Serve side (:func:`derive_serve_knobs`): no probes — the bucket-shape
+set and ``batch_wait_ms`` fall out of the *observed* request-size
+distribution against the SLO (percentile sizes rounded up the
+power-of-two ladder; wait budgeted as a fixed fraction of the SLO).
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable
+
+from tpuframe.autotune import probe as _probe
+from tpuframe.autotune.config import (
+    TunedConfig,
+    default_host,
+    save_tuned,
+)
+from tpuframe.autotune.diagnosis import Diagnosis, diagnose
+
+__all__ = ["derive_serve_knobs", "tune_training"]
+
+
+def _rounds() -> int:
+    try:
+        v = int(os.environ.get("TPUFRAME_AUTOTUNE_ROUNDS", "").strip() or 6)
+    except ValueError:
+        v = 6
+    return max(1, v)
+
+
+def tune_training(
+    run_fn: Callable[[dict], list[float]],
+    report: dict | None = None, *,
+    host: str | None = None,
+    topology: str = "1",
+    signature: str = "",
+    gauges: dict | None = None,
+    moves: Iterable | None = None,
+    save: bool = True,
+    store_dir: str | None = None,
+) -> TunedConfig:
+    """Probe the diagnosis-ordered knob moves and persist the winner.
+
+    ``run_fn(env) -> [per-step wall seconds]`` is the probe workload —
+    typically a handful of real training steps on the real loader (the
+    bench harness and the acceptance test build it from a Trainer
+    factory).  ``report`` is ``track.analyze.skew_report`` output for
+    the mis-behaving run; without one, the candidate list must come in
+    via ``moves``.
+    """
+    from tpuframe.track.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    host = host or default_host()
+    diag: Diagnosis | None = None
+    if moves is None:
+        diag = diagnose(report or {}, gauges=gauges)
+        moves = diag.moves
+    moves = list(moves)
+
+    baseline_p50 = _probe.measure(run_fn, {})
+    cfg = TunedConfig(
+        host=host, topology=topology, signature=signature,
+        env={}, source="train", baseline_p50_s=baseline_p50,
+        tuned_p50_s=baseline_p50,
+    )
+    tel.event("autotune/start", bound=diag.bound if diag else "manual",
+              baseline_p50_s=round(baseline_p50, 6), candidates=len(moves))
+
+    for mv in moves[: _rounds()]:
+        candidate = dict(cfg.env)
+        candidate[mv.knob] = mv.value
+        if candidate == cfg.env:
+            continue  # committed earlier round already covers this value
+        result = _probe.run_probe(run_fn, candidate, cfg.tuned_p50_s)
+        record = result.to_dict()
+        record["knob"], record["reason_for_move"] = mv.knob, mv.reason
+        cfg.probes.append(record)
+        tel.event(
+            "autotune/probe", knob=mv.knob, value=mv.value,
+            p50_s=round(result.p50_s, 6),
+            baseline_p50_s=round(result.baseline_p50_s, 6),
+            committed=result.committed,
+        )
+        if result.committed:
+            cfg.env = candidate
+            cfg.tuned_p50_s = result.p50_s
+
+    if save:
+        save_tuned(cfg, store_dir)
+    tel.event(
+        "autotune/tuned", knobs=len(cfg.env),
+        baseline_p50_s=round(cfg.baseline_p50_s or 0.0, 6),
+        tuned_p50_s=round(cfg.tuned_p50_s or 0.0, 6),
+        convergence_ratio=round(cfg.convergence_ratio or 1.0, 4),
+        signature=signature,
+    )
+    return cfg
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _percentile(sorted_xs: list, q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = min(len(sorted_xs) - 1, int(q * (len(sorted_xs) - 1) + 0.5))
+    return sorted_xs[idx]
+
+
+def derive_serve_knobs(sizes: Iterable[int], *, slo_ms: float,
+                       max_bucket: int | None = None) -> dict[str, str]:
+    """Serve knobs derived from the observed request-size distribution.
+
+    Buckets: the p50/p95/max request sizes, each rounded up the
+    power-of-two ladder and deduped — small frequent requests get a snug
+    bucket (less padding waste), the tail still fits without a shape
+    miss.  ``batch_wait_ms``: 5% of the SLO, clamped to [0.5, 20] ms —
+    enough hold-open to fill a bucket at high rates without spending the
+    latency budget on waiting.  Returns env-encoded knobs (the same
+    shape :class:`TunedConfig.env` persists); empty observation returns
+    just the wait default.
+    """
+    out: dict[str, str] = {
+        "TPUFRAME_SERVE_BATCH_WAIT_MS":
+            str(round(min(20.0, max(0.5, slo_ms * 0.05)), 3)),
+    }
+    xs = sorted(int(s) for s in sizes if int(s) > 0)
+    if not xs:
+        return out
+    marks = {_pow2_at_least(int(_percentile(xs, q))) for q in (0.5, 0.95)}
+    marks.add(_pow2_at_least(xs[-1]))
+    if max_bucket is not None:
+        marks = {min(m, int(max_bucket)) for m in marks}
+    out["TPUFRAME_SERVE_BUCKETS"] = ",".join(str(b) for b in sorted(marks))
+    return out

@@ -85,6 +85,20 @@ COMPILE_ENV_VARS = (
     "TPUFRAME_PRECOMPILE",
 )
 
+#: value domains for the knobs above (KN007; AUTOTUNE.md explains the
+#: ``apply`` field: "live" = re-read at every use, "restart" = read once
+#: at enable/construction, a supervised restart picks up new values).
+COMPILE_ENV_DOMAINS = {
+    "TPUFRAME_COMPILE_CACHE": {"type": "path", "apply": "restart"},
+    "TPUFRAME_COMPILE_CACHE_MAX_MB": {
+        "type": "float", "range": (0, None), "apply": "live"},
+    "TPUFRAME_COMPILE_CACHE_KEEP": {
+        "type": "int", "range": (0, None), "apply": "live"},
+    "TPUFRAME_COMPILE_MIN_COMPILE_S": {
+        "type": "float", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_PRECOMPILE": {"type": "bool", "apply": "restart"},
+}
+
 _FALSY = ("0", "false", "no", "off", "disabled")
 
 #: process-wide state: the enabled cache dir (None = not enabled here)

@@ -44,7 +44,41 @@ PERF_ENV_VARS = (
     "TPUFRAME_PALLAS_INTERPRET",
     "TPUFRAME_DEBUG",
     "TPUFRAME_CKPT_DIR",
+    "TPUFRAME_LOADER_WORKERS",
+    "TPUFRAME_LOADER_RING_BUFFERS",
+    "TPUFRAME_LOADER_TRANSFER_DTYPE",
+    "TPUFRAME_PREFETCH_DEPTH",
+    "TPUFRAME_GRAD_ACCUM",
+    "TPUFRAME_CKPT_INTERVAL_BATCHES",
 )
+
+#: value domains for the knobs above (KN007).  ``apply`` semantics per
+#: AUTOTUNE.md: the loader/prefetch/grad-accum knobs are env-defaults
+#: resolved when DataLoader/Trainer objects are built -> "restart"
+#: (a supervised restart — or a fresh probe run — picks them up);
+#: TPUFRAME_CKPT_INTERVAL_BATCHES is re-read by the running Trainer's
+#: step loop via ``Trainer.apply_tuned`` -> "live".
+PERF_ENV_DOMAINS = {
+    "TPUFRAME_NATIVE_JPEG": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_JPEG_THREADS": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_DISABLE_PALLAS": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_PALLAS_INTERPRET": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_DEBUG": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_CKPT_DIR": {"type": "path", "apply": "restart"},
+    "TPUFRAME_LOADER_WORKERS": {
+        "type": "int", "range": (0, 64), "apply": "restart"},
+    "TPUFRAME_LOADER_RING_BUFFERS": {
+        "type": "int", "range": (2, 64), "apply": "restart"},
+    "TPUFRAME_LOADER_TRANSFER_DTYPE": {
+        "type": "enum", "choices": ("uint8", "float32"), "apply": "restart"},
+    "TPUFRAME_PREFETCH_DEPTH": {
+        "type": "int", "range": (1, 16), "apply": "restart"},
+    "TPUFRAME_GRAD_ACCUM": {
+        "type": "int", "range": (1, 256), "apply": "restart"},
+    "TPUFRAME_CKPT_INTERVAL_BATCHES": {
+        "type": "int", "range": (1, None), "apply": "live"},
+}
 
 
 @dataclasses.dataclass(frozen=True)

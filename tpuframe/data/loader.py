@@ -237,6 +237,9 @@ class DataLoader:
         the last batch is padded to full size and a boolean ``valid`` mask is
         yielded as third element (static shapes for jit-eval).
       num_workers: worker pool size for item fetch/transform (0 = inline).
+        ``None`` (default) reads ``TPUFRAME_LOADER_WORKERS`` (else 0) —
+        the env default is what lets the autotuner's winning config
+        apply on a supervised restart without a code edit.
       worker_mode: ``"thread"`` (default — fine when decode releases the
         GIL and transforms are light) or ``"process"`` — a persistent
         pool that sidesteps the GIL entirely for numpy-heavy
@@ -250,8 +253,9 @@ class DataLoader:
         avoid that entirely but pickle the dataset once at pool creation
         (StreamingDataset pickles fine; locks/caches are re-created).
       transfer_dtype: dtype of the assembled batch buffers — what
-        actually crosses host->HBM.  ``None`` (default) follows the
-        first sample's dtype.  ``"uint8"`` is the 4x-less-PCIe path:
+        actually crosses host->HBM.  ``None`` (default) reads
+        ``TPUFRAME_LOADER_TRANSFER_DTYPE``; unset, the buffers follow
+        the first sample's dtype.  ``"uint8"`` is the 4x-less-PCIe path:
         pair with a geometric-only transform
         (:func:`tpuframe.data.transforms.uint8_image_transforms`) and
         on-device normalization (``Trainer(normalize=...)`` or the
@@ -260,7 +264,8 @@ class DataLoader:
         ``transfer_dtype="uint8"`` raises instead of silently
         truncating.
       ring_buffers: size of the preallocated batch-buffer pool (the
-        assembly ring).  Batches are views of pooled buffers, recycled
+        assembly ring); ``None`` (default) reads
+        ``TPUFRAME_LOADER_RING_BUFFERS`` (else 4).  Batches are views of pooled buffers, recycled
         after the :class:`DevicePrefetcher` finishes the device copy;
         steady-state assembly allocations are zero.  Consumers that
         hold many batches at once simply trigger fresh allocations
@@ -275,18 +280,32 @@ class DataLoader:
         shuffle: bool = False,
         seed: int = 0,
         drop_last: bool = True,
-        num_workers: int = 0,
+        num_workers: int | None = None,
         worker_mode: str = "thread",
         mp_context: str = "fork",
         process_index: int | None = None,
         process_count: int | None = None,
         transfer_dtype: str | None = None,
-        ring_buffers: int = 4,
+        ring_buffers: int | None = None,
     ):
         if worker_mode not in ("thread", "process"):
             raise ValueError(
                 f"worker_mode must be 'thread' or 'process', got {worker_mode!r}"
             )
+        # env-defaulted knobs (tolerant reads; explicit arguments win) —
+        # the seam through which a persisted autotune config reaches a
+        # freshly constructed loader on a supervised restart
+        from tpuframe.fault.health import _env_int
+
+        if num_workers is None:
+            num_workers = max(0, _env_int("TPUFRAME_LOADER_WORKERS", 0))
+        if ring_buffers is None:
+            ring_buffers = max(2, _env_int("TPUFRAME_LOADER_RING_BUFFERS", 4))
+        if transfer_dtype is None:
+            env_dtype = os.environ.get(
+                "TPUFRAME_LOADER_TRANSFER_DTYPE", "").strip().lower()
+            if env_dtype in ("uint8", "float32"):
+                transfer_dtype = env_dtype
         multiprocessing.get_context(mp_context)  # fail at init, not mid-train
         self.mp_context = mp_context
         self.dataset = dataset

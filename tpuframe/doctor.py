@@ -339,6 +339,60 @@ def comms_section() -> dict:
     return out
 
 
+def autotune_section(devices: dict | None = None) -> dict:
+    """State of the self-tuning loop (``tpuframe.autotune``): whether it
+    is armed, where the per-``(host, topology, signature)`` configs
+    persist, every config stored for THIS host (the plan signature is
+    run-scoped, so the doctor lists all of the host's entries and marks
+    which match the probed topology), and the paste-ready one-liners —
+    so a "my run is slow" report says up front whether a tuned config
+    exists and what it would set.  Stdlib-only reads — works against a
+    wedged backend, like the serve/ckpt sections."""
+    from tpuframe.autotune.config import (
+        AUTOTUNE_ENV_VARS,
+        autotune_dir,
+        autotune_enabled,
+        default_host,
+        list_tuned,
+    )
+
+    host = default_host()
+    topology = None
+    if devices and isinstance(devices.get("device_count"), int):
+        topology = (f"{devices.get('process_count', 1)}x"
+                    f"{devices['device_count']}")
+    out: dict = {
+        "enabled": autotune_enabled(),
+        "store": autotune_dir(),
+        "host": host,
+        "topology": topology,
+        "env": {
+            k: os.environ[k] for k in AUTOTUNE_ENV_VARS if k in os.environ
+        },
+        # the paste-ready pair, consistent with the other sections: what
+        # is persisted, and how to (re)tune this host
+        "show": "python -m tpuframe.autotune --json",
+        "tune": ("TPUFRAME_AUTOTUNE=1 python benchmarks/bench_autotune.py "
+                 "--json"),
+    }
+    configs = []
+    for cfg in list_tuned():
+        if cfg.host != host:
+            continue
+        configs.append({
+            "topology": cfg.topology,
+            "signature": cfg.signature,
+            "source": cfg.source,
+            "env": dict(cfg.env),
+            "convergence_ratio": cfg.convergence_ratio,
+            "matches_probed_topology": (
+                None if topology is None else cfg.topology == topology
+            ),
+        })
+    out["configs"] = configs
+    return out
+
+
 def lint_section() -> dict:
     """State of the invariant linter (``tpuframe.lint``): the full pass
     run in-process over the installed tree — finding count per rule and
@@ -412,6 +466,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "health": health_section(ckpt_dir),
         "serve": serve_section(export_path),
         "comms": comms_section(),
+        "autotune": autotune_section(devices),
         "lint": lint_section(),
         "env": {
             k: os.environ[k]

@@ -84,6 +84,33 @@ HEALTH_ENV_VARS = (
     "TPUFRAME_CKPT_SAVE_RETRIES",
 )
 
+#: value domains for the knobs above (KN007; ``apply`` per AUTOTUNE.md:
+#: the policy knobs are snapshotted by ``resolve_policy`` at Trainer
+#: construction -> "restart"; the two per-use reads stay "live").
+HEALTH_ENV_DOMAINS = {
+    "TPUFRAME_HEALTH": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_HEALTH_SPIKE_FACTOR": {
+        "type": "float", "range": (1.0, None), "apply": "restart"},
+    "TPUFRAME_HEALTH_SPIKE_MARGIN": {
+        "type": "float", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_HEALTH_EWMA_DECAY": {
+        "type": "float", "range": (0, 1.0), "apply": "restart"},
+    "TPUFRAME_HEALTH_WARMUP_STEPS": {
+        "type": "int", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_HEALTH_WINDOW": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_HEALTH_MAX_BAD": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_HEALTH_LR_BACKOFF": {
+        "type": "float", "range": (0, 1.0), "apply": "restart"},
+    "TPUFRAME_HEALTH_SKIP_BATCHES": {
+        "type": "int", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_MAX_BAD_SAMPLES": {
+        "type": "int", "range": (0, None), "apply": "live"},
+    "TPUFRAME_CKPT_SAVE_RETRIES": {
+        "type": "int", "range": (0, None), "apply": "live"},
+}
+
 _FALSY = ("0", "false", "no", "off", "disabled")
 
 

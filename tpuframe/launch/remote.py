@@ -94,6 +94,31 @@ LAUNCH_CONTRACT_ENV_VARS = (  # tpuframe-lint: not-shipped
     "TPUFRAME_NATIVE_KEEP_BUILDS",
 )
 
+#: value domains for the launch contract (KN007).  All "restart" by
+#: construction — these are per-worker identity/infrastructure values
+#: the launcher computes at spawn; rewriting them inside a live worker
+#: is meaningless.
+LAUNCH_CONTRACT_ENV_DOMAINS = {
+    "TPUFRAME_PROCESS_ID": {
+        "type": "int", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_NUM_PROCESSES": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_COORDINATOR": {"type": "str", "apply": "restart"},
+    "TPUFRAME_CP_PORT": {
+        "type": "int", "range": (1, 65535), "apply": "restart"},
+    "TPUFRAME_CP_TOKEN": {"type": "str", "apply": "restart"},
+    "TPUFRAME_CP_BIND": {"type": "str", "apply": "restart"},
+    "TPUFRAME_HB_PORT": {
+        "type": "int", "range": (1, 65535), "apply": "restart"},
+    "TPUFRAME_HB_ADDR": {"type": "str", "apply": "restart"},
+    "TPUFRAME_SIMULATE_DEVICES": {
+        "type": "int", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_RESULT_DIR": {"type": "path", "apply": "restart"},
+    "TPUFRAME_LOCAL_SCRATCH": {"type": "path", "apply": "restart"},
+    "TPUFRAME_NATIVE_KEEP_BUILDS": {
+        "type": "int", "range": (0, None), "apply": "restart"},
+}
+
 
 def all_env_vars() -> tuple[str, ...]:
     """Every spine's env-knob list, aggregated — THE single registry
@@ -102,14 +127,16 @@ def all_env_vars() -> tuple[str, ...]:
     Each spine declares its own list next to its knobs
     (``OBSERVABILITY_ENV_VARS``, ``COMPILE_ENV_VARS``,
     ``HEALTH_ENV_VARS``, ``SERVE_ENV_VARS``, ``PERF_ENV_VARS``,
-    ``COMMS_ENV_VARS``); new spines add themselves HERE, and both
-    consumers pick them up for free — the concrete first step toward
-    the ROADMAP item-5 typed knob registry.  All six source modules are
+    ``COMMS_ENV_VARS``, ``AUTOTUNE_ENV_VARS``); new spines add
+    themselves HERE, and both consumers pick them up for free — the
+    concrete first step toward the ROADMAP item-5 typed knob registry.
+    All seven source modules are
     stdlib-only imports (no jax), so this resolves on a wedged-backend
     doctor run too.  The invariant linter (``tpuframe.lint`` rule
     KN004) fails tier-1 if a knob list exists that this aggregate does
     not reach.
     """
+    from tpuframe.autotune.config import AUTOTUNE_ENV_VARS
     from tpuframe.compile.cache import COMPILE_ENV_VARS
     from tpuframe.core.workspace import PERF_ENV_VARS
     from tpuframe.fault.health import HEALTH_ENV_VARS
@@ -118,7 +145,8 @@ def all_env_vars() -> tuple[str, ...]:
     from tpuframe.track.telemetry import OBSERVABILITY_ENV_VARS
 
     return (OBSERVABILITY_ENV_VARS + COMPILE_ENV_VARS + HEALTH_ENV_VARS
-            + SERVE_ENV_VARS + PERF_ENV_VARS + COMMS_ENV_VARS)
+            + SERVE_ENV_VARS + PERF_ENV_VARS + COMMS_ENV_VARS
+            + AUTOTUNE_ENV_VARS)
 
 
 class _Worker:

@@ -59,7 +59,15 @@ def main(argv=None) -> int:
                 docs = ", ".join(row["docs"]) or "undocumented"
                 default = (f" default={row['defaults'][0]!r}"
                            if row["defaults"] else "")
-                print(f"{row['name']}: {lists}{default} [{docs}] "
+                d = row.get("domain")
+                if d:
+                    constraint = d.get("choices") or d.get("range")
+                    domain = (f" <{d['type']}"
+                              + (f" {constraint}" if constraint else "")
+                              + f" apply={d['apply']}>")
+                else:
+                    domain = " <no domain>"
+                print(f"{row['name']}: {lists}{default}{domain} [{docs}] "
                       f"({len(row['reads'])} read site(s))")
             print(f"{len(inventory)} knob(s)")
         return 0

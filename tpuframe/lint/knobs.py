@@ -15,6 +15,12 @@ this family exists to kill.  Rules:
   on its assignment line) that ``all_env_vars()`` does not aggregate.
 - **KN005** — a declared knob documented in none of OBSERVABILITY.md /
   FAULT.md / SERVE.md / PERF.md.
+- **KN007** — a declared knob with no (or an invalid) value domain in
+  the sibling ``*_ENV_DOMAINS`` dict, or a domain entry for a knob the
+  list no longer declares.  The domains are the autotuner's legal
+  search space (``type``/``range``/``choices``) and its re-application
+  contract (``apply``: "live" | "restart") — an undomained knob is a
+  knob the autotuner must not touch, so the gap fails loud.
 
 Read detection covers ``os.environ.get/[]``, ``os.getenv``,
 ``"X" in os.environ``, and one level of indirection: any function whose
@@ -43,6 +49,7 @@ RULES = {
     "KN004": "shipped *_ENV_VARS list not aggregated by all_env_vars()",
     "KN005": "declared knob documented in no schema doc",
     "KN006": "all_env_vars() imports a knob list from a non-stdlib-only module",
+    "KN007": "declared knob missing (or carrying an invalid/stale) value domain",
 }
 
 _PREFIX = "TPUFRAME_"
@@ -99,6 +106,87 @@ def collect_lists(repo: Repo) -> list[KnobList]:
                 line=node.lineno, entries=entries, shipped=shipped,
             ))
     return out
+
+
+@dataclasses.dataclass
+class KnobDomains:
+    name: str          # the *_ENV_DOMAINS symbol
+    module: str
+    rel: str
+    line: int
+    entries: dict[str, dict]
+
+
+#: legal values for the domain entry fields KN007 validates
+_DOMAIN_TYPES = ("int", "float", "bool", "enum", "str", "path")
+_DOMAIN_APPLY = ("live", "restart")
+
+
+def collect_domains(repo: Repo) -> list[KnobDomains]:
+    """Every ``*_ENV_DOMAINS`` dict-literal assignment, evaluated.  A
+    non-literal dict (computed keys, comprehension) collects as empty —
+    which KN007 then reports as every knob missing its domain, the
+    correct failure for a registry that must be statically readable."""
+    out = []
+    for src in repo.files.values():
+        for node in src.nodes:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not (isinstance(target, ast.Name)
+                    and target.id.endswith("_ENV_DOMAINS")):
+                continue
+            entries: dict[str, dict] = {}
+            if isinstance(node.value, ast.Dict):
+                try:
+                    raw = ast.literal_eval(node.value)
+                except ValueError:
+                    raw = {}
+                entries = {
+                    k: v for k, v in raw.items()
+                    if isinstance(k, str) and isinstance(v, dict)
+                }
+            out.append(KnobDomains(
+                name=target.id, module=src.module, rel=src.rel,
+                line=node.lineno, entries=entries,
+            ))
+    return out
+
+
+def _domain_error(entry: dict) -> str | None:
+    """Why ``entry`` is not a usable domain, or None when it is."""
+    t = entry.get("type")
+    if t not in _DOMAIN_TYPES:
+        return f"'type' must be one of {_DOMAIN_TYPES}, got {t!r}"
+    if entry.get("apply") not in _DOMAIN_APPLY:
+        return f"'apply' must be one of {_DOMAIN_APPLY}"
+    if t == "enum":
+        choices = entry.get("choices")
+        if not (isinstance(choices, (tuple, list)) and choices
+                and all(isinstance(c, str) for c in choices)):
+            return "enum domain needs a non-empty 'choices' tuple of strings"
+    if t in ("int", "float"):
+        rng = entry.get("range")
+        if not (isinstance(rng, (tuple, list)) and len(rng) == 2):
+            return "numeric domain needs a 'range' pair (lo, hi); " \
+                   "either bound may be None"
+        lo, hi = rng
+        ok = all(b is None or isinstance(b, (int, float)) for b in (lo, hi))
+        if not ok or (lo is not None and hi is not None and lo > hi):
+            return f"'range' bounds must be numbers-or-None with lo <= hi, " \
+                   f"got {rng!r}"
+    return None
+
+
+def _domains_for(kl: KnobList,
+                 domains: list[KnobDomains]) -> KnobDomains | None:
+    """The sibling domains dict for a knob list: same module, same
+    prefix (``X_ENV_VARS`` <-> ``X_ENV_DOMAINS``)."""
+    want = kl.name[: -len("_ENV_VARS")] + "_ENV_DOMAINS"
+    for kd in domains:
+        if kd.module == kl.module and kd.name == want:
+            return kd
+    return None
 
 
 def _env_param_readers(repo: Repo) -> dict[str, int]:
@@ -283,19 +371,25 @@ def knob_inventory(repo: Repo) -> list[dict]:
     typed knob registry (ROADMAP item 5)."""
     lists = collect_lists(repo)
     reads = collect_reads(repo)
+    domains = collect_domains(repo)
     by_name: dict[str, dict] = {}
 
     def row(name: str) -> dict:
         return by_name.setdefault(name, {
             "name": name, "lists": [], "defaults": [], "reads": [],
-            "docs": [], "shipped": False,
+            "docs": [], "shipped": False, "domain": None,
         })
 
     for kl in lists:
+        kd = _domains_for(kl, domains)
         for name in kl.entries:
             r = row(name)
             r["lists"].append(f"{kl.module}.{kl.name}")
             r["shipped"] = r["shipped"] or kl.shipped
+            if kd is not None and r["domain"] is None:
+                d = kd.entries.get(name)
+                if d is not None and _domain_error(d) is None:
+                    r["domain"] = d
     for rd in reads:
         r = row(rd.name)
         r["reads"].append(f"{rd.rel}:{rd.line}")
@@ -427,4 +521,59 @@ def check(repo: Repo) -> list[Finding]:
                 ),
                 hint="add a row to the owning spine's knob table",
             ))
+
+    # KN007: every declared knob needs a valid entry in the sibling
+    # *_ENV_DOMAINS dict, and every domain entry needs a declaring knob
+    # — the autotuner trusts this registry as its legal search space.
+    domains = collect_domains(repo)
+    for kl in lists:
+        kd = _domains_for(kl, domains)
+        if kd is None:
+            findings.append(Finding(
+                rule="KN007", file=kl.rel, line=kl.line,
+                message=(
+                    f"{kl.name} has no sibling "
+                    f"{kl.name[:-len('_ENV_VARS')]}_ENV_DOMAINS dict — "
+                    f"{len(kl.entries)} knob(s) have no value domain"
+                ),
+                hint=(
+                    "declare a literal *_ENV_DOMAINS dict beside the list: "
+                    "{'KNOB': {'type': ..., 'range'/'choices': ..., "
+                    "'apply': 'live'|'restart'}}"
+                ),
+            ))
+            continue
+        for name in kl.entries:
+            entry = kd.entries.get(name)
+            if entry is None:
+                findings.append(Finding(
+                    rule="KN007", file=kd.rel, line=kd.line,
+                    message=(
+                        f"knob {name!r} is declared in {kl.name} but has "
+                        f"no entry in {kd.name} — the autotuner has no "
+                        "legal search space for it"
+                    ),
+                    hint=(
+                        "add {'type': ..., 'range'/'choices': ..., "
+                        "'apply': 'live'|'restart'} for it"
+                    ),
+                ))
+                continue
+            err = _domain_error(entry)
+            if err is not None:
+                findings.append(Finding(
+                    rule="KN007", file=kd.rel, line=kd.line,
+                    message=f"domain entry for {name!r} is invalid: {err}",
+                    hint="fix the entry so the inventory can expose it",
+                ))
+        for name in kd.entries:
+            if name not in kl.entries:
+                findings.append(Finding(
+                    rule="KN007", file=kd.rel, line=kd.line,
+                    message=(
+                        f"{kd.name} carries an entry for {name!r}, which "
+                        f"{kl.name} does not declare — a stale domain row"
+                    ),
+                    hint="drop the entry or re-declare the knob",
+                ))
     return findings

@@ -41,6 +41,18 @@ COMMS_ENV_VARS = (
     "TPUFRAME_COMMS_EF",
 )
 
+#: value domains for the knobs above (KN007).  All "restart":
+#: ``CommsConfig.from_env`` is snapshotted when the train step is
+#: built, and changing the wire format retraces the step anyway.
+COMMS_ENV_DOMAINS = {
+    "TPUFRAME_COMMS_COMPRESSION": {
+        "type": "enum", "choices": ("", "int8", "fp8"), "apply": "restart"},
+    "TPUFRAME_COMMS_BUCKET_MB": {
+        "type": "float", "range": (0.25, 1024.0), "apply": "restart"},
+    "TPUFRAME_COMMS_STOCHASTIC": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_COMMS_EF": {"type": "bool", "apply": "restart"},
+}
+
 #: wire formats the compressed collectives understand
 COMPRESSION_MODES = ("int8", "fp8")
 

@@ -71,6 +71,29 @@ SERVE_ENV_VARS = (
     "TPUFRAME_SERVE_EXPORT",
 )
 
+#: value domains for the knobs above (KN007).  ``apply``: buckets /
+#: queue_cap / max_pixels shape the pools and the AOT-compiled set at
+#: ``ServeEngine.start()`` -> "restart"; the wait/SLO/shed/watchdog
+#: policy rides on the knobs object ``ServeEngine.apply_knobs`` can
+#: swap on a running engine -> "live".
+SERVE_ENV_DOMAINS = {
+    "TPUFRAME_SERVE_BUCKETS": {"type": "str", "apply": "restart"},
+    "TPUFRAME_SERVE_SLO_MS": {
+        "type": "float", "range": (1.0, None), "apply": "live"},
+    "TPUFRAME_SERVE_QUEUE_CAP": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_SERVE_SHED_POLICY": {
+        "type": "enum", "choices": ("reject-new", "shed-oldest"),
+        "apply": "live"},
+    "TPUFRAME_SERVE_BATCH_WAIT_MS": {
+        "type": "float", "range": (0, None), "apply": "live"},
+    "TPUFRAME_SERVE_MAX_PIXELS": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_SERVE_WATCHDOG_S": {
+        "type": "float", "range": (0, None), "apply": "live"},
+    "TPUFRAME_SERVE_EXPORT": {"type": "path", "apply": "live"},
+}
+
 #: pixel budget default — PIL's ``MAX_IMAGE_PIXELS`` (the same ceiling
 #: the native decode guard enforces), hardcoded so this module stays
 #: stdlib-only on hosts without PIL

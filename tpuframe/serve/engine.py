@@ -48,6 +48,7 @@ instead of flooding the JSONL log).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import itertools
 import threading
@@ -230,6 +231,12 @@ class ServeEngine:
         self._h_latency = reg.histogram("serve/latency")
         self._h_occupancy = reg.histogram("serve/batch_occupancy")
         self._g_draining = reg.gauge("serve/draining")
+        # observed request-batch sizes (bounded; batcher thread appends,
+        # the autotuner reads a snapshot) — the empirical distribution
+        # tpuframe.autotune.derive_serve_knobs turns into a bucket set
+        self._observed_sizes: collections.deque = collections.deque(
+            maxlen=4096
+        )
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServeEngine":
@@ -280,6 +287,63 @@ class ServeEngine:
 
     def queue_depth(self) -> int:
         return self._admission.depth()
+
+    # -- autotune ------------------------------------------------------------
+    def observed_request_sizes(self) -> list[int]:
+        """Snapshot of recently observed request-batch sizes (bounded
+        window) — the input ``tpuframe.autotune.derive_serve_knobs``
+        shapes the bucket set and ``batch_wait_ms`` from."""
+        return list(self._observed_sizes)
+
+    def apply_knobs(self, env: dict) -> dict:
+        """Apply a derived/tuned serve config to the running engine.
+
+        The live subset (``batch_wait_ms``/``slo_ms``/``watchdog_s``/
+        ``shed_policy`` — everything the loop reads off ``self.knobs``
+        per call) lands by swapping the frozen knobs object; the
+        restart-only subset (``buckets``/``queue_cap``/``max_pixels``,
+        baked into the pools and the AOT-compiled set at
+        :meth:`start`) is returned unapplied so the caller can export
+        it for the next engine.  Returns the same ``{"applied": ...,
+        "restart_only": ...}`` shape as ``Trainer.apply_tuned``.
+        """
+        import dataclasses as _dc
+
+        live_fields = {
+            "TPUFRAME_SERVE_BATCH_WAIT_MS": ("batch_wait_ms", float),
+            "TPUFRAME_SERVE_SLO_MS": ("slo_ms", float),
+            "TPUFRAME_SERVE_WATCHDOG_S": ("watchdog_s", float),
+            "TPUFRAME_SERVE_SHED_POLICY": ("shed_policy", str),
+        }
+        applied: dict[str, str] = {}
+        restart_only: dict[str, str] = {}
+        updates: dict[str, Any] = {}
+        for knob, value in env.items():
+            target = live_fields.get(knob)
+            if target is None:
+                restart_only[knob] = str(value)
+                continue
+            field, cast = target
+            try:
+                cast_value = cast(value)
+            except (TypeError, ValueError):
+                continue
+            if field == "shed_policy" and cast_value not in (
+                "reject-new", "shed-oldest"
+            ):
+                continue
+            updates[field] = cast_value
+            applied[knob] = str(value)
+        if updates:
+            self.knobs = _dc.replace(self.knobs, **updates)
+            if "shed_policy" in updates:
+                self._admission.policy = updates["shed_policy"]
+        if applied or restart_only:
+            get_telemetry().event(
+                "autotune/apply", applied=len(applied),
+                restart_only=len(restart_only), side="serve",
+            )
+        return {"applied": applied, "restart_only": restart_only}
 
     # -- door ----------------------------------------------------------------
     def submit(self, x: Any, *, deadline_ms: float | None = None) -> ServeResult:
@@ -505,6 +569,7 @@ class ServeEngine:
                 continue
             done = time.monotonic()
             self._h_occupancy.observe(n / bucket)
+            self._observed_sizes.append(n)
             self._c_batches.inc()
             for i, r in enumerate(batch):
                 lat = done - r.t_submit

@@ -451,6 +451,38 @@ def _time_to_first_step(rl: RankLog) -> float | None:
     return max(0.0, first_step - t0)
 
 
+# -- skew_report as a library API ---------------------------------------------
+# The autotuner (tpuframe.autotune.diagnosis) and the baseline differ
+# both consume skew_report's dict as a stable contract.  The key sets
+# below ARE that contract: adding a key is backwards-compatible (bump
+# the minor), removing or renaming one breaks consumers (bump the major
+# and update tpuframe/autotune + the golden structural test together).
+SKEW_REPORT_VERSION = "1.0"
+
+# Top-level keys, always present (value may be None for the optional
+# blocks: time_to_first_step, health, comms, serve_latency, slowest).
+SKEW_REPORT_KEYS = (
+    "schema_version", "ranks", "hosts", "steps", "warmup_steps_skipped",
+    "compile", "time_to_first_step", "health", "straggler_factor",
+    "comms", "serve_latency", "step_time", "step_wall", "total_lost_s",
+    "straggler_lost_s", "straggling_steps", "lost_by_bound", "slowest",
+    "per_rank", "per_step",
+)
+
+# Row contracts for the two per-entity tables.
+SKEW_REPORT_PER_RANK_KEYS = (
+    "rank", "host", "steps", "excess_s", "straggling_steps",
+    "data_wait_total_s",
+)
+SKEW_REPORT_PER_STEP_KEYS = (
+    "batch", "n_ranks", "min_s", "median_s", "max_s", "slowest_rank",
+    "lost_s", "bound", "straggling",
+)
+
+# The decomposition classes lost_by_bound always carries.
+SKEW_REPORT_BOUNDS = ("input", "compute", "checkpoint")
+
+
 def skew_report(ranks: Sequence[RankLog], *,
                 straggler_factor: float = 1.5,
                 warmup_steps: int = 1) -> dict:
@@ -611,6 +643,7 @@ def skew_report(ranks: Sequence[RankLog], *,
             },
         }
     return {
+        "schema_version": SKEW_REPORT_VERSION,
         "ranks": len(ranks),
         "hosts": sorted({rl.hostname for rl in ranks if rl.hostname}),
         "steps": len(per_step),

@@ -99,6 +99,30 @@ OBSERVABILITY_ENV_VARS = (
     "TPUFRAME_FLEET_TIMEOUT_S",
 )
 
+#: machine-readable value domains for the knobs above (KN007 keeps the
+#: two in lockstep).  ``apply`` says whether a new value takes effect on
+#: a running process ("live": re-read at every use) or only on a
+#: supervised restart ("restart": read once at configure/construction) —
+#: the autotuner's legal search space and re-application contract.
+OBSERVABILITY_ENV_DOMAINS = {
+    "TPUFRAME_TELEMETRY_DIR": {"type": "path", "apply": "restart"},
+    "TPUFRAME_TELEMETRY_MAX_MB": {
+        "type": "float", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_TELEMETRY_KEEP": {
+        "type": "int", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_WATCHDOG_S": {
+        "type": "float", "range": (0, None), "apply": "restart"},
+    "TPUFRAME_WATCHDOG_DEADLINES": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_STRAGGLER_STEPS": {
+        "type": "int", "range": (1, None), "apply": "live"},
+    "TPUFRAME_STRAGGLER_FACTOR": {
+        "type": "float", "range": (1.0, None), "apply": "live"},
+    "TPUFRAME_PREEMPT_SIGNALS": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_FLEET_TIMEOUT_S": {
+        "type": "float", "range": (0, None), "apply": "live"},
+}
+
 
 def _env_rank() -> int:
     """Process rank from the launch env (never imports jax: telemetry must

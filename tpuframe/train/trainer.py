@@ -210,7 +210,7 @@ class Trainer:
         eval_interval: int = 1,
         log_interval: int = 10,
         report: Callable[[dict, str | None], None] | None = None,
-        grad_accum: int = 1,
+        grad_accum: int | None = None,
         grad_clip: float | None = None,
         grad_compression: str | None = None,
         normalize: tuple | None = None,
@@ -242,6 +242,12 @@ class Trainer:
         self.seed = seed
         self.checkpointer = checkpointer
         self.checkpoint_interval = checkpoint_interval
+        if checkpoint_interval_batches is None:
+            # env-defaulted (tolerant): the cadence half of the autotune
+            # config; also live-appliable later via apply_tuned() — the
+            # step loop re-reads the attribute every batch
+            env_ckpt = _health._env_int("TPUFRAME_CKPT_INTERVAL_BATCHES", 0)
+            checkpoint_interval_batches = env_ckpt if env_ckpt > 0 else None
         self.checkpoint_interval_batches = checkpoint_interval_batches
         self.eval_interval = eval_interval
         self.log_interval = log_interval
@@ -344,6 +350,10 @@ class Trainer:
         self._train_prefetcher: DevicePrefetcher | None = None
         self._intra_ck: Any = None  # lazy sibling checkpointer (snapshots)
 
+        if grad_accum is None:
+            # env default (tolerant, restart-apply — the accum factor is
+            # baked into the compiled step below)
+            grad_accum = max(1, _health._env_int("TPUFRAME_GRAD_ACCUM", 1))
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum
@@ -960,6 +970,9 @@ class Trainer:
             )
         pf = DevicePrefetcher(
             host_iter(),
+            # env-defaulted pipeline depth (tolerant read): how many
+            # batches the H2D copy runs ahead of the consuming step
+            depth=max(1, _health._env_int("TPUFRAME_PREFETCH_DEPTH", 2)),
             sharding=self.plan.batch_sharding(leading_microbatch=accum > 1),
             track_loader=loader if train and trackable else None,
             # ring-buffer recycling: host_iter yields exactly one dict per
@@ -972,9 +985,73 @@ class Trainer:
             self._train_prefetcher = pf
         yield from pf
 
+    # -- autotune ----------------------------------------------------------
+    def _autotune_identity(self) -> tuple[str, str, str]:
+        """The persistence key the autotune store uses for this run:
+        (host, topology, plan signature) — same-host ranks and a
+        supervised restart of the same program share it; a different
+        world shape or plan misses and tunes fresh."""
+        from tpuframe.autotune.config import default_host
+
+        topology = f"{rt.process_count()}x{rt.current_runtime().device_count}"
+        return default_host(), topology, self.plan.signature()
+
+    def apply_tuned(self, env: Mapping[str, str]) -> dict:
+        """Apply a tuned config's env to this process: every knob is
+        written to ``os.environ`` (so per-use readers and anything
+        constructed later — eval loaders, a supervisor's next attempt —
+        see it), and the domain registry's ``apply`` field classifies
+        each into ``applied`` (live effect now; the mid-epoch snapshot
+        cadence is additionally pushed onto the running loop) vs
+        ``restart_only`` (takes effect at the next construction).
+        Returns ``{"applied": {...}, "restart_only": {...}}``.
+        """
+        from tpuframe.autotune.config import all_env_domains
+
+        domains = all_env_domains()
+        applied: dict[str, str] = {}
+        restart_only: dict[str, str] = {}
+        for knob, value in env.items():
+            d = domains.get(knob)
+            if d is None:
+                continue  # not in the legal registry: never apply
+            os.environ[knob] = str(value)
+            if d.get("apply") == "live":
+                applied[knob] = str(value)
+            else:
+                restart_only[knob] = str(value)
+        if "TPUFRAME_CKPT_INTERVAL_BATCHES" in applied:
+            # the one live knob the Trainer itself re-reads per step
+            iv = _health._env_int("TPUFRAME_CKPT_INTERVAL_BATCHES", 0)
+            self.checkpoint_interval_batches = iv if iv > 0 else None
+        if applied or restart_only:
+            get_telemetry().event(
+                "autotune/apply", applied=len(applied),
+                restart_only=len(restart_only), side="train",
+            )
+        return {"applied": applied, "restart_only": restart_only}
+
+    def apply_persisted_tuning(self) -> dict:
+        """Load the persisted winning config for this run's identity and
+        :meth:`apply_tuned` it.  Called from :meth:`fit` when
+        ``TPUFRAME_AUTOTUNE`` is truthy — the supervised-restart half of
+        the loop: the restarting attempt (and every same-host rank)
+        starts tuned without re-probing.  No config is a no-op."""
+        from tpuframe.autotune.config import load_tuned
+
+        host, topology, signature = self._autotune_identity()
+        cfg = load_tuned(host, topology, signature)
+        if cfg is None:
+            return {}
+        return self.apply_tuned(cfg.env)
+
     # -- the loop ----------------------------------------------------------
     def fit(self) -> FitResult:
         """Run to max_duration; returns the Ray-style FitResult."""
+        from tpuframe.autotune.config import autotune_enabled
+
+        if autotune_enabled():
+            self.apply_persisted_tuning()
         result = FitResult()
         state = self.init_state()
         if self.preemption is True:
