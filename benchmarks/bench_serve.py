@@ -25,6 +25,14 @@ exported StableHLO artifact and drives it two ways:
 Zero ``compile/recompile`` events across the whole run is asserted into
 the record: every served batch hit a precompiled bucket shape.
 
+``--fleet`` runs the fleet variant instead: N=3 supervised replicas
+behind the health-aware :class:`~tpuframe.serve.router.Router`, measured
+over real HTTP against a single-replica HTTP baseline, then a **rolling
+promotion** of a healthy-stamped checkpoint under sustained client load
+— the record proves aggregate throughput, p99 under the rolling
+restart, and ``dropped_in_flight=0`` through the swap (committed as
+``benchmarks/results/bench_serve_fleet_cpu.json``).
+
 Prints ONE JSON line (committed as
 ``benchmarks/results/bench_serve_cpu.json``; the capture ladder re-runs
 it on a live TPU window).
@@ -108,6 +116,184 @@ def closed_loop(engine, payloads, clients: int, per_client: int):
     return time.perf_counter() - t0, lats, errors
 
 
+def http_closed_loop(url: str, blobs, clients: int, per_client: int):
+    """Closed-loop over real HTTP: ``clients`` threads POSTing ``.npy``
+    bodies back-to-back at ``url``/predict.  Returns
+    (wall_s, server_latencies_s, status_counts)."""
+    import urllib.error
+    import urllib.request
+
+    lats: list[float] = []
+    statuses: dict = {}
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        for i in range(per_client):
+            body = blobs[(ci * per_client + i) % len(blobs)]
+            req = urllib.request.Request(
+                url + "/predict", data=body, method="POST",
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    doc = json.loads(resp.read().decode())
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                code, doc = e.code, {}
+            except Exception:
+                code, doc = -1, {}
+            with lock:
+                statuses[code] = statuses.get(code, 0) + 1
+                if code == 200:
+                    lats.append(float(doc.get("latency_ms", 0.0)) / 1e3)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, lats, statuses
+
+
+def _fabricate_healthy_ckpt(dirpath: str) -> str:
+    """A committed checkpoint step with a clean health stamp — what a
+    real training run leaves behind, minus the arrays the promotion
+    gate never reads."""
+    step_dir = os.path.join(dirpath, "100")
+    os.makedirs(os.path.join(step_dir, "meta"), exist_ok=True)
+    open(os.path.join(step_dir, "_CHECKPOINT_METADATA"), "w").close()
+    with open(os.path.join(step_dir, "meta", "metadata"), "w") as f:
+        json.dump({"health": {"healthy": True, "loss_ewma": 0.1,
+                              "bad_steps": 0}}, f)
+    return dirpath
+
+
+def run_fleet(args, served, payloads, backend: str,
+              device_kind: str) -> dict:
+    import io as _io
+
+    import numpy as np
+
+    from tpuframe.serve import ReplicaSet, ServeKnobs, ServingServer
+    from tpuframe.serve.engine import ServeEngine
+    from tpuframe.serve.router import FleetKnobs
+    from tpuframe.track.telemetry import get_telemetry
+
+    reg = get_telemetry().registry
+    recompiles0 = reg.counter("compile/recompiles").value
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    knobs = ServeKnobs(buckets=buckets, slo_ms=args.slo_ms,
+                       queue_cap=256, batch_wait_ms=1.0)
+    fleet_knobs = FleetKnobs(probe_ms=25.0, retries=2, retry_budget=0.2,
+                             replicas=3, shadow_requests=16)
+    per_client = args.requests or (30 if backend == "cpu" else 150)
+    blobs = []
+    for p in payloads:
+        buf = _io.BytesIO()
+        np.save(buf, p)
+        blobs.append(buf.getvalue())
+
+    # ---- single-replica HTTP baseline ------------------------------------
+    eng = ServeEngine(served, knobs=knobs).start()
+    srv = ServingServer(eng)
+    http_closed_loop(srv.url, blobs[:1], 1, 1)  # warmup round-trip
+    wall, lats, statuses = http_closed_loop(srv.url, blobs, 8, per_client)
+    eng.drain(timeout=30)
+    srv.close()
+    single = {
+        "rps": round(len(lats) / wall, 1),
+        "latency": _latency_block(lats),
+        "statuses": {str(k): v for k, v in sorted(statuses.items())},
+    }
+    print(f"# single replica: {single['rps']} req/s over HTTP",
+          file=sys.stderr)
+
+    # ---- N=3 fleet through the router ------------------------------------
+    with ReplicaSet(served, n=3, serve_knobs=knobs,
+                    fleet_knobs=fleet_knobs) as fleet:
+        http_closed_loop(fleet.router.url, blobs[:1], 1, 1)  # warmup
+        wall, lats, statuses = http_closed_loop(
+            fleet.router.url, blobs, 8, per_client
+        )
+        fleet_block = _latency_block(lats)
+        fleet_run = {
+            "replicas": 3,
+            "rps": round(len(lats) / wall, 1),
+            "latency": fleet_block,
+            "statuses": {str(k): v for k, v in sorted(statuses.items())},
+            "speedup_vs_single": round(
+                (len(lats) / wall) / max(1e-9, single["rps"]), 2),
+        }
+        print(f"# fleet n=3: {fleet_run['rps']} req/s "
+              f"({fleet_run['speedup_vs_single']}x single)", file=sys.stderr)
+
+        # ---- rolling promotion under sustained load ----------------------
+        ckpt_dir = _fabricate_healthy_ckpt(
+            os.path.join(args.workdir, "promo_ckpt")
+        )
+        promo_lats: list[float] = []
+        promo_statuses: dict = {}
+        stop_bg = threading.Event()
+
+        def background() -> None:
+            i = 0
+            while not stop_bg.is_set():
+                _, ls, st = http_closed_loop(
+                    fleet.router.url, blobs[i % len(blobs):][:4], 2, 2
+                )
+                promo_lats.extend(ls)
+                for k, v in st.items():
+                    promo_statuses[k] = promo_statuses.get(k, 0) + v
+                i += 1
+
+        bg = threading.Thread(target=background, daemon=True)
+        bg.start()
+        time.sleep(0.2)
+        result = fleet.promote(served, ckpt_dir=ckpt_dir, step=100)
+        time.sleep(0.2)
+        stop_bg.set()
+        bg.join(timeout=30)
+        promo_block = _latency_block(promo_lats)
+        rolling = {
+            "swapped": result["swapped"],
+            "dropped_in_flight": result["dropped_in_flight"],
+            "agreement": result["agreement"],
+            "generation": result["generation"],
+            "during_promotion": promo_block,
+            "during_promotion_p99_ms": round(promo_block["p99"] * 1e3, 2),
+            "statuses": {str(k): v
+                         for k, v in sorted(promo_statuses.items())},
+            "slo_ms": args.slo_ms,
+            "p99_under_slo": promo_block["p99"] * 1e3 <= args.slo_ms,
+        }
+        print(f"# promotion: swapped={rolling['swapped']} dropped="
+              f"{rolling['dropped_in_flight']} "
+              f"p99={rolling['during_promotion_p99_ms']}ms", file=sys.stderr)
+
+    recompiles = reg.counter("compile/recompiles").value - recompiles0
+    return {
+        "metric": "serve_fleet_throughput_rps",
+        "value": fleet_run["rps"],
+        "unit": ("closed-loop HTTP requests/s through the router over 3 "
+                 f"supervised replicas (MnistNet {args.image_size}px, "
+                 f"buckets {list(buckets)}, {backend})"),
+        "backend": backend,
+        "device_kind": device_kind,
+        "buckets": list(buckets),
+        "slo_ms": args.slo_ms,
+        "per_client_requests": per_client,
+        # the baseline-gated block: fleet-wide served latency under the
+        # plain (no-chaos) fleet run
+        "serve_latency": fleet_block,
+        "single": single,
+        "fleet": {k: v for k, v in fleet_run.items() if k != "latency"},
+        "rolling_restart": rolling,
+        "recompile_events": int(recompiles),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--export", default=None,
@@ -126,6 +312,9 @@ def main() -> int:
     ap.add_argument("--overload-cap", type=int, default=8,
                     help="admission queue cap under overload")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet variant: 3 supervised replicas "
+                         "behind the router + rolling promotion under load")
     args = ap.parse_args()
 
     import jax
@@ -149,6 +338,11 @@ def main() -> int:
     rng = np.random.default_rng(args.seed)
     payloads = [rng.random(item_shape, dtype=np.float32).astype(dtype)
                 for _ in range(32)]
+
+    if args.fleet:
+        record = run_fleet(args, served, payloads, backend, device_kind)
+        print(json.dumps(record))
+        return 0
 
     reg = get_telemetry().registry
     recompiles0 = reg.counter("compile/recompiles").value

@@ -188,6 +188,16 @@ run bench_autotune.json        300  python benchmarks/bench_autotune.py --json
 # against (SERVE.md); cheap, rides with the fault/analyze pair
 run bench_serve.json           300  python benchmarks/bench_serve.py
 
+# fleet rung: single-replica HTTP baseline vs 3 supervised replicas
+# through the router, then a rolling promotion of a healthy-stamped
+# checkpoint under sustained client load — on the TPU host this prices
+# aggregate fleet throughput and the during-promotion p99 against the
+# real per-bucket inference wall; the committed record carries
+# rolling_restart.dropped_in_flight=0 and the fleet-wide serve_latency
+# block the analyzer baseline-gates (SERVE.md "Fleet"); value-ordered
+# just below the single-engine serve rung it extends
+run bench_serve_fleet.json     300  python benchmarks/bench_serve.py --fleet
+
 # wire-collectives rung: bytes-on-wire (static ring model, backend-
 # independent) + the MEASURED compressed-allreduce wall and matched A/B
 # step time on the real chip — the committed `comms` block is what

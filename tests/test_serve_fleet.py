@@ -1,0 +1,587 @@
+"""Fleet serving (PR 13): supervised replica sets, health-aware routing,
+zero-drop promotion.
+
+Tier-1 stories:
+- a chaos-killed replica is routed around, restarted warm, and
+  re-admitted — zero client-visible 5xx under load;
+- a rolling promotion of a healthy-stamped checkpoint drops zero
+  in-flight requests and keeps p99 under the SLO;
+- an unhealthy promotion (chaos taint, dirty stamp, failed shadow gate)
+  is refused loudly and the old model keeps serving.
+
+Plus the satellites: Retry-After on shed/drain replies, the richer
+/healthz body, knob registry coverage, strict-vs-tolerant meta readers
+on truncated/garbage files, the doctor ``fleet`` section, and the
+per-replica analyzer breakout.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+def _linear_model(item_shape=(4, 3), classes=3, seed=0):
+    n = int(np.prod(item_shape))
+    W = np.random.RandomState(seed).rand(n, classes).astype(np.float32)
+
+    def fn(x):
+        return jnp.asarray(x).reshape(x.shape[0], -1) @ W
+
+    return fn, W
+
+
+def _knobs(**over):
+    from tpuframe.serve import ServeKnobs
+
+    kn = dict(buckets=(1, 4), slo_ms=5000, queue_cap=64, batch_wait_ms=1.0)
+    kn.update(over)
+    return ServeKnobs(**kn)
+
+
+def _blob(seed=0):
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, np.random.RandomState(seed).rand(4, 3).astype(np.float32))
+    return buf.getvalue()
+
+
+def _post(url, blob, timeout=10.0):
+    req = urllib.request.Request(
+        url + "/predict", data=blob, method="POST",
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _fleet(n=2, **fleet_over):
+    from tpuframe.serve import ReplicaSet
+    from tpuframe.serve.router import FleetKnobs
+
+    fn, W = _linear_model()
+    fk = dict(probe_ms=25.0, retries=2, retry_budget=0.5, replicas=n,
+              shadow_requests=8, gate_agreement=0.99)
+    fk.update(fleet_over)
+    fleet = ReplicaSet(
+        fn, n=n, serve_knobs=_knobs(), fleet_knobs=FleetKnobs(**fk),
+        item_shape=(4, 3), dtype="float32",
+    )
+    return fleet, W
+
+
+# ===========================================================================
+# knobs + registry (satellite 3)
+# ===========================================================================
+
+
+class TestFleetKnobs:
+    def test_defaults(self):
+        from tpuframe.serve.router import FleetKnobs
+
+        k = FleetKnobs()
+        assert k.probe_ms == 50.0 and k.retries == 2
+        assert k.replicas == 3 and 0 < k.gate_agreement <= 1.0
+
+    def test_from_env_overrides_and_clamps(self, monkeypatch):
+        from tpuframe.serve.router import FleetKnobs
+
+        monkeypatch.setenv("TPUFRAME_ROUTER_PROBE_MS", "10")
+        monkeypatch.setenv("TPUFRAME_ROUTER_RETRIES", "-3")
+        monkeypatch.setenv("TPUFRAME_ROUTER_RETRY_BUDGET", "7.5")
+        monkeypatch.setenv("TPUFRAME_FLEET_REPLICAS", "0")
+        monkeypatch.setenv("TPUFRAME_FLEET_GATE_AGREEMENT", "0.5")
+        k = FleetKnobs.from_env()
+        assert k.probe_ms == 10.0
+        assert k.retries == 0          # clamped up from -3
+        assert k.retry_budget == 1.0   # clamped down from 7.5
+        assert k.replicas == 1         # a zero-replica fleet is no fleet
+        assert k.gate_agreement == 0.5
+
+    def test_malformed_env_reads_as_default(self, monkeypatch):
+        from tpuframe.serve.router import FleetKnobs
+
+        monkeypatch.setenv("TPUFRAME_ROUTER_PROBE_MS", "soon")
+        assert FleetKnobs.from_env().probe_ms == FleetKnobs().probe_ms
+
+    def test_every_fleet_knob_is_registered(self):
+        from tpuframe.serve.admission import SERVE_ENV_DOMAINS, SERVE_ENV_VARS
+
+        fleet_vars = [v for v in SERVE_ENV_VARS
+                      if v.startswith(("TPUFRAME_ROUTER_", "TPUFRAME_FLEET_"))]
+        assert len(fleet_vars) == 6
+        assert set(SERVE_ENV_DOMAINS) == set(SERVE_ENV_VARS)
+        for v in fleet_vars:
+            assert SERVE_ENV_DOMAINS[v]["apply"] == "restart"
+
+
+# ===========================================================================
+# router unit behavior (tentpole, no replicas needed)
+# ===========================================================================
+
+
+class TestRouterUnit:
+    def test_no_backend_is_503_with_retry_after(self):
+        from tpuframe.serve.router import Router
+
+        r = Router()  # never started: zero backends
+        status, body, headers = r.handle_predict(_blob(), {})
+        assert status == 503
+        doc = json.loads(body)
+        assert doc["verdict"] == "no-backend"
+        assert int(headers["Retry-After"]) >= 1
+
+    def test_pick_is_least_loaded(self):
+        from tpuframe.serve.router import Router, _Backend
+
+        r = Router()
+        for url, depth in [("http://x:1", 9), ("http://x:2", 1),
+                           ("http://x:3", 4)]:
+            b = _Backend(url)
+            b.healthy, b.queue_depth = True, depth
+            r._backends[url] = b
+        assert r._pick(set()) == "http://x:2"
+        assert r._pick({"http://x:2"}) == "http://x:3"
+
+    def test_pick_skips_draining_and_unhealthy(self):
+        from tpuframe.serve.router import Router, _Backend
+
+        r = Router()
+        a, b = _Backend("http://x:1"), _Backend("http://x:2")
+        a.healthy, a.draining = True, True
+        b.healthy = False
+        r._backends.update({a.url: a, b.url: b})
+        assert r._pick(set()) is None
+
+    def test_retry_budget_caps_amplification(self):
+        from tpuframe.serve.router import FleetKnobs, Router
+
+        # counters are process-global: drive the gate relative to
+        # whatever the registry already holds
+        r = Router(knobs=FleetKnobs(retry_budget=0.2))
+        spins = 0
+        while r._retry_allowed():
+            r._c_retries.inc()
+            spins += 1
+            assert spins < 10_000, "retry budget never closed"
+        cap = r.knobs.retry_budget * r._c_requests.value + 1
+        assert r._c_retries.value >= cap
+        r._c_requests.inc(100)     # fresh traffic replenishes the budget
+        assert r._retry_allowed()
+
+    def test_payload_mirror_ring_is_bounded(self):
+        from tpuframe.serve.router import Router
+
+        r = Router()
+        for i in range(r.MIRROR_RING + 7):
+            with r._lock:
+                r._mirror.append(bytes([i % 251]))
+        assert len(r.recent_payloads()) == r.MIRROR_RING
+
+
+# ===========================================================================
+# server satellites: Retry-After + richer /healthz
+# ===========================================================================
+
+
+class TestServerFleetFacing:
+    def test_healthz_carries_queue_depth_and_draining(self):
+        from tpuframe.serve import ServeEngine, ServingServer
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                          dtype="float32").start()
+        srv = ServingServer(eng, port=0)
+        try:
+            with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+                doc = json.loads(r.read())
+            assert doc["status"] == "ok"
+            assert doc["draining"] is False
+            assert isinstance(doc["queue_depth"], int)
+        finally:
+            srv.close()
+            eng.stop()
+
+    def test_draining_replica_503s_with_retry_after(self):
+        from tpuframe.serve import ServeEngine, ServingServer
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                          dtype="float32").start()
+        srv = ServingServer(eng, port=0)
+        try:
+            assert eng.drain(timeout=10.0)
+            status, doc, headers = _post(srv.url, _blob())
+            assert status == 503
+            assert doc["verdict"] == "rejected-draining"
+            assert 1 <= int(headers["Retry-After"]) <= 30
+            with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+                hz = json.loads(r.read())
+            assert hz["status"] == "draining" and hz["draining"] is True
+        finally:
+            srv.close()
+            eng.stop()
+
+    def test_retry_after_scales_with_queue_depth(self):
+        from tpuframe.serve import ServeEngine, ServingServer
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(batch_wait_ms=1000.0),
+                          item_shape=(4, 3), dtype="float32")
+        srv = ServingServer(eng, port=0)
+        try:
+            handler = srv._retry_after
+            hdr = handler()
+            assert 1 <= int(hdr["Retry-After"]) <= 30
+        finally:
+            srv.close()
+
+
+# ===========================================================================
+# strict vs tolerant meta readers (satellite 4)
+# ===========================================================================
+
+
+def _committed_step(tmp_path, step=100, meta=None, meta_bytes=None):
+    d = tmp_path / "ckpt"
+    sd = d / str(step)
+    (sd / "meta").mkdir(parents=True)
+    (sd / "_CHECKPOINT_METADATA").write_text("{}")
+    if meta_bytes is not None:
+        (sd / "meta" / "metadata").write_bytes(meta_bytes)
+    elif meta is not None:
+        (sd / "meta" / "metadata").write_text(json.dumps(meta))
+    return str(d)
+
+
+class TestCkptHealthVerdict:
+    """The promotion gate refuses loudly on anything it cannot
+    positively read — it never crashes, and it never silently passes a
+    corrupt candidate."""
+
+    def test_empty_dir_refuses(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        ok, reason = ckpt_health_verdict(str(tmp_path))
+        assert not ok and "no committed" in reason
+
+    def test_torn_step_refuses(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        (tmp_path / "50").mkdir()  # digit dir, no commit marker
+        ok, reason = ckpt_health_verdict(str(tmp_path), 50)
+        assert not ok and "commit marker" in reason
+
+    def test_pre_sentinel_checkpoint_passes(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        d = _committed_step(tmp_path)  # committed, no meta file at all
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert ok and "pre-sentinel" in reason
+
+    def test_garbage_meta_refuses_not_crashes(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        d = _committed_step(tmp_path, meta_bytes=b"\x00\xffnot json at all")
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert not ok and "unreadable" in reason
+
+    def test_truncated_meta_refuses_not_crashes(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        full = json.dumps({"health": {"healthy": True}})
+        d = _committed_step(tmp_path,
+                            meta_bytes=full[: len(full) // 2].encode())
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert not ok and "unreadable" in reason
+
+    def test_non_dict_meta_refuses(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        d = _committed_step(tmp_path, meta_bytes=b"[1, 2, 3]")
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert not ok and "not a JSON object" in reason
+
+    def test_malformed_health_stamp_refuses(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        d = _committed_step(tmp_path, meta={"health": "fine, trust me"})
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert not ok and "malformed" in reason
+
+    def test_unhealthy_stamp_refuses(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        d = _committed_step(tmp_path, meta={"health": {"healthy": False}})
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert not ok and "unhealthy" in reason
+
+    def test_clean_stamp_passes(self, tmp_path):
+        from tpuframe.ckpt import ckpt_health_verdict
+
+        d = _committed_step(
+            tmp_path, meta={"health": {"healthy": True, "bad_steps": 0}})
+        ok, reason = ckpt_health_verdict(d, 100)
+        assert ok and "clean" in reason
+
+    def test_tolerant_read_health_stays_tolerant(self, tmp_path):
+        """read_health (doctor-shaped) returns None on the same garbage
+        the strict gate refuses — both must survive, neither crashes."""
+        from tpuframe.ckpt import read_health
+
+        d = _committed_step(tmp_path, meta_bytes=b"\x00garbage")
+        assert read_health(d, 100) is None
+        assert read_health(d) is None
+
+
+class TestReadExportMetaRobustness:
+    def test_truncated_file_is_valueerror(self, tmp_path):
+        from tpuframe.serve.admission import read_export_meta
+
+        p = tmp_path / "export.tpuf"
+        p.write_bytes(b"\x03")  # shorter than the 8-byte length prefix
+        with pytest.raises(ValueError, match="not a tpuframe export"):
+            read_export_meta(p)
+
+    def test_huge_declared_header_is_valueerror_not_oom(self, tmp_path):
+        from tpuframe.serve.admission import read_export_meta
+
+        p = tmp_path / "export.tpuf"
+        p.write_bytes((2**62).to_bytes(8, "little") + b"xx")
+        with pytest.raises(ValueError, match="not a tpuframe export"):
+            read_export_meta(p)
+
+    def test_garbage_header_bytes_are_valueerror(self, tmp_path):
+        from tpuframe.serve.admission import read_export_meta
+
+        p = tmp_path / "export.tpuf"
+        p.write_bytes((4).to_bytes(8, "little") + b"\xff\xfe\x00\x01")
+        with pytest.raises(ValueError, match="not a tpuframe export"):
+            read_export_meta(p)
+
+
+# ===========================================================================
+# chaos story (a): ReplicaKill under load (tentpole)
+# ===========================================================================
+
+
+@pytest.mark.chaos
+class TestReplicaKillStory:
+    def test_kill_routes_around_and_restarts_warm(self):
+        import time
+
+        from tpuframe.fault import ChaosPlan, ReplicaKill
+        from tpuframe.track.telemetry import get_telemetry
+
+        reg = get_telemetry().registry
+        restarts0 = reg.counter("fleet/restarts").value
+        compiles0 = (reg.counter("compile/compiles").value
+                     + reg.counter("compile/recompiles").value)
+
+        fleet, _ = _fleet(n=2, probe_ms=20.0)
+        plan = ChaosPlan([ReplicaKill(step=3)])
+        statuses: dict[int, int] = {}
+        with fleet, plan.active():
+            url = fleet.router.url
+            deadline = time.monotonic() + 2.0
+            i = 0
+            while time.monotonic() < deadline:
+                status, _, _ = _post(url, _blob(i))
+                statuses[status] = statuses.get(status, 0) + 1
+                i += 1
+            # wait for the supervisor to bring the killed replica back
+            # green: detection + backoff + rebuild, all bounded
+            for _ in range(200):
+                if len(fleet.router.healthy_backends()) == 2:
+                    break
+                time.sleep(0.05)
+            assert len(fleet.router.healthy_backends()) == 2
+
+        # zero client-visible 5xx: every request either served or was
+        # retried onto the surviving replica within budget
+        assert set(statuses) == {200}, statuses
+        assert statuses[200] == i > 0
+        # the kill burned exactly restart budget, not compile budget:
+        # the rebuilt replica came back warm off the persistent cache
+        assert reg.counter("fleet/restarts").value >= restarts0 + 1
+        compiles1 = (reg.counter("compile/compiles").value
+                     + reg.counter("compile/recompiles").value)
+        assert compiles1 == compiles0, "restart must be warm (AOT cache)"
+
+    def test_replica_kill_without_ctx_is_misconfigured_drill(self):
+        from tpuframe.fault import ReplicaKill
+
+        with pytest.raises(ValueError, match="fleet/replica"):
+            ReplicaKill(step=0).fire({"step": 0})
+
+
+# ===========================================================================
+# stories (b) + (c): promotion — zero-drop roll vs loud refusal
+# ===========================================================================
+
+
+@pytest.mark.chaos
+class TestPromotionStories:
+    def test_rolling_promotion_drops_nothing(self):
+        from tpuframe.track.telemetry import get_telemetry
+
+        reg = get_telemetry().registry
+        promoted0 = reg.counter("fleet/promotions").value
+        fleet, W = _fleet(n=2)
+        fn2, _ = _linear_model(seed=0)  # same weights: agreement == 1.0
+        with fleet:
+            for i in range(6):  # real mirrored traffic for the shadow gate
+                status, _, _ = _post(fleet.router.url, _blob(i))
+                assert status == 200
+            gen0 = fleet.generation
+            out = fleet.promote(fn2, timeout_s=30.0)
+            assert out["swapped"] == 2
+            assert out["dropped_in_flight"] == 0
+            assert out["agreement"] >= 0.99
+            assert out["generation"] == gen0 + 1
+            # the rolled fleet still serves
+            status, doc, _ = _post(fleet.router.url, _blob(99))
+            assert status == 200 and doc["verdict"] == "ok"
+        assert reg.counter("fleet/promotions").value == promoted0 + 1
+
+    def test_promotion_gated_on_checkpoint_stamp(self, tmp_path):
+        from tpuframe.serve import PromotionRefused
+
+        fleet, _ = _fleet(n=1)
+        fn2, _ = _linear_model(seed=0)
+        dirty = _committed_step(tmp_path,
+                                meta={"health": {"healthy": False}})
+        with fleet:
+            with pytest.raises(PromotionRefused, match="unhealthy"):
+                fleet.promote(fn2, ckpt_dir=dirty, step=100)
+            # the old model keeps serving
+            status, _, _ = _post(fleet.router.url, _blob())
+            assert status == 200
+
+    def test_promotion_gated_on_garbage_stamp(self, tmp_path):
+        from tpuframe.serve import PromotionRefused
+
+        fleet, _ = _fleet(n=1)
+        fn2, _ = _linear_model(seed=0)
+        garbage = _committed_step(tmp_path, meta_bytes=b"\x00not json")
+        with fleet:
+            with pytest.raises(PromotionRefused, match="unreadable"):
+                fleet.promote(fn2, ckpt_dir=garbage, step=100)
+            status, _, _ = _post(fleet.router.url, _blob())
+            assert status == 200
+
+    def test_shadow_gate_refuses_a_disagreeing_candidate(self):
+        from tpuframe.serve import PromotionRefused
+        from tpuframe.track.telemetry import get_telemetry
+
+        reg = get_telemetry().registry
+        refused0 = reg.counter("fleet/promotions_refused").value
+        fleet, W = _fleet(n=1)
+
+        def hostile(x):  # argmax-inverts every prediction: agreement 0
+            return -(jnp.asarray(x).reshape(x.shape[0], -1) @ W)
+
+        with fleet:
+            for i in range(6):
+                _post(fleet.router.url, _blob(i))
+            before = _post(fleet.router.url, _blob(7))[1]["output"]
+            with pytest.raises(PromotionRefused, match="agreement"):
+                fleet.promote(hostile)
+            after = _post(fleet.router.url, _blob(7))[1]["output"]
+            np.testing.assert_allclose(before, after, rtol=1e-5)
+        assert reg.counter("fleet/promotions_refused").value >= refused0 + 1
+
+    def test_unhealthy_promotion_chaos_taints_the_candidate(self, tmp_path):
+        from tpuframe.fault import ChaosPlan, UnhealthyPromotion
+        from tpuframe.serve import PromotionRefused
+
+        fleet, _ = _fleet(n=1)
+        fn2, _ = _linear_model(seed=0)
+        clean = _committed_step(tmp_path,
+                                meta={"health": {"healthy": True}})
+        # step=None: fire on the first promote attempt this fleet makes
+        with fleet, ChaosPlan([UnhealthyPromotion()]).active():
+            with pytest.raises(PromotionRefused, match="chaos"):
+                fleet.promote(fn2, ckpt_dir=clean, step=100)
+            status, _, _ = _post(fleet.router.url, _blob())
+            assert status == 200
+
+    def test_unhealthy_promotion_without_ctx_is_misconfigured(self):
+        from tpuframe.fault import UnhealthyPromotion
+
+        with pytest.raises(ValueError, match="fleet/promote"):
+            UnhealthyPromotion(step=0).fire({"step": 0})
+
+
+# ===========================================================================
+# doctor + analyzer satellites
+# ===========================================================================
+
+
+class TestDoctorFleetSection:
+    def test_section_shape(self, monkeypatch):
+        from tpuframe.doctor import fleet_section
+
+        monkeypatch.setenv("TPUFRAME_FLEET_REPLICAS", "5")
+        sec = fleet_section()
+        assert sec["knobs"]["replicas"] == 5
+        assert sec["env"] == {"TPUFRAME_FLEET_REPLICAS": "5"}
+        assert sec["detection_window_ms"] == sec["knobs"]["probe_ms"]
+        assert sec["bench"].endswith("bench_serve.py --fleet")
+
+    def test_report_includes_fleet(self):
+        from tpuframe.doctor import report
+
+        assert "fleet" in report()
+
+
+class TestAnalyzePerReplica:
+    def test_replica_tagged_requests_break_out(self, tmp_path):
+        from tpuframe.serve import ServeEngine
+        from tpuframe.track import telemetry as T
+        from tpuframe.track.analyze import load_dir, skew_report
+
+        fn, _ = _linear_model()
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            for rep in (0, 1):
+                eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                                  dtype="float32", replica=rep)
+                with eng:
+                    for i in range(5):
+                        eng.submit(
+                            np.random.RandomState(i).rand(4, 3)
+                            .astype(np.float32)).result(timeout=10)
+        finally:
+            T.reset()
+        sv = skew_report(load_dir(str(tmp_path)))["serve_latency"]
+        assert sv["count"] == 10
+        assert sv["replicas"] == 2
+        assert set(sv["per_replica"]) == {"0", "1"} or \
+            set(sv["per_replica"]) == {0, 1}
+        for block in sv["per_replica"].values():
+            assert block["count"] == 5 and block["p50"] <= block["p99"]
+
+
+class TestFleetBenchRecord:
+    def test_committed_record_feeds_baseline_gate(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, os.pardir, "benchmarks", "results",
+                            "bench_serve_fleet_cpu.json")
+        if not os.path.exists(path):
+            pytest.skip("fleet bench record not committed yet")
+        with open(path) as f:
+            rec = json.load(f)
+        assert rec["metric"] == "serve_fleet_throughput_rps"
+        assert rec["serve_latency"]["count"] > 0
+        assert rec["rolling_restart"]["dropped_in_flight"] == 0
+        assert rec["rolling_restart"]["p99_under_slo"] is True

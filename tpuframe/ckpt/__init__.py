@@ -25,6 +25,7 @@ validate checkpoints against a wedged backend.
 _LAZY = {
     "Checkpointer": "tpuframe.ckpt.checkpoint",
     "best_checkpoint_path": "tpuframe.ckpt.checkpoint",
+    "ckpt_health_verdict": "tpuframe.ckpt.meta",
     "healthy_steps": "tpuframe.ckpt.meta",
     "is_committed": "tpuframe.ckpt.meta",
     "latest_healthy_step": "tpuframe.ckpt.meta",

@@ -23,6 +23,7 @@ from tpuframe.track.telemetry import get_telemetry
 
 __all__ = [
     "COMMIT_MARKERS",
+    "ckpt_health_verdict",
     "healthy_steps",
     "is_committed",
     "is_healthy",
@@ -161,6 +162,47 @@ def read_health(directory: str | os.PathLike, step: int | None = None) -> dict |
     checkpoints or when no committed step exists."""
     doc = _read_meta_doc(directory, step)
     return doc.get("health") if doc else None
+
+
+def ckpt_health_verdict(directory: str | os.PathLike,
+                        step: int | None = None) -> tuple[bool, str]:
+    """Strict health gate for promotion: ``(ok, reason)``.
+
+    Unlike :func:`read_health` (tolerant — None for absent *and* corrupt,
+    the right shape for the doctor) and :func:`is_healthy` (absent counts
+    healthy, the right shape for rollback), a *promotion* gate must
+    refuse on anything it cannot positively read: an uncommitted step, a
+    truncated/garbage meta file, or a non-dict stamp is a loud "no", not
+    a crash and not a silent pass.  A genuinely absent meta file on a
+    committed step (pre-sentinel checkpoint) still passes — old-format
+    history stays promotable, exactly like rollback treats it.
+    """
+    directory = os.fspath(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            return False, f"no committed checkpoint step under {directory}"
+    step_dir = os.path.join(directory, str(step))
+    if not is_committed(step_dir):
+        return False, f"step {step} has no commit marker (torn save?)"
+    path = os.path.join(step_dir, "meta", "metadata")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return True, f"step {step}: no meta stamp (pre-sentinel) — healthy"
+    except (OSError, ValueError) as e:
+        return False, f"step {step} meta unreadable ({e!r}) — refusing"
+    if not isinstance(doc, dict):
+        return False, f"step {step} meta is not a JSON object — refusing"
+    health = doc.get("health")
+    if health is None:
+        return True, f"step {step}: no health stamp — healthy"
+    if not isinstance(health, dict):
+        return False, f"step {step} health stamp malformed — refusing"
+    if not health.get("healthy", True):
+        return False, f"step {step} stamped unhealthy by the sentinel"
+    return True, f"step {step}: health stamp clean"
 
 
 def is_healthy(directory: str | os.PathLike, step: int) -> bool:

@@ -310,6 +310,33 @@ def serve_section(export_path: str | None = None) -> dict:
     return out
 
 
+def fleet_section() -> dict:
+    """State of the fleet layer (``tpuframe.serve.fleet``): the
+    router/replica-set knobs (env overrides applied), the
+    ``TPUFRAME_ROUTER_*``/``TPUFRAME_FLEET_*`` env subset, the bounded
+    detection window those knobs imply, and the paste-ready fleet bench
+    one-liner.  Stdlib-only (:class:`~tpuframe.serve.router.FleetKnobs`
+    never touches jax), like the serve section."""
+    import dataclasses
+
+    from tpuframe.serve.admission import SERVE_ENV_VARS
+    from tpuframe.serve.router import FleetKnobs
+
+    knobs = FleetKnobs.from_env()
+    return {
+        "knobs": dataclasses.asdict(knobs),
+        "env": {
+            k: os.environ[k] for k in SERVE_ENV_VARS
+            if k.startswith(("TPUFRAME_ROUTER_", "TPUFRAME_FLEET_"))
+            and k in os.environ
+        },
+        # worst-case probe-driven rotation delay; in-band forwarding
+        # failures rotate a replica out immediately, ahead of this
+        "detection_window_ms": knobs.probe_ms,
+        "bench": "python benchmarks/bench_serve.py --fleet",
+    }
+
+
 def comms_section() -> dict:
     """State of the wire-compression spine
     (``tpuframe.parallel.compression``): the resolved compression config
@@ -465,6 +492,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "ckpt": ckpt_section(ckpt_dir, devices.get("device_count")),
         "health": health_section(ckpt_dir),
         "serve": serve_section(export_path),
+        "fleet": fleet_section(),
         "comms": comms_section(),
         "autotune": autotune_section(devices),
         "lint": lint_section(),

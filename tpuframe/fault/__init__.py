@@ -37,10 +37,12 @@ from tpuframe.fault.chaos import (
     QueueFlood,
     RaiseAt,
     RankLostError,
+    ReplicaKill,
     SlowConsumer,
     SpikeAt,
     StallAt,
     TornCheckpoint,
+    UnhealthyPromotion,
     lost_ranks,
     reset_lost_ranks,
 )
@@ -87,12 +89,14 @@ __all__ = [
     "QueueFlood",
     "RaiseAt",
     "RankLostError",
+    "ReplicaKill",
     "RestartPolicy",
     "SlowConsumer",
     "SpikeAt",
     "StallAt",
     "Supervisor",
     "TornCheckpoint",
+    "UnhealthyPromotion",
     "WorldTooSmall",
     "backoff_delay",
     "classify_failure",

@@ -50,10 +50,12 @@ __all__ = [
     "QueueFlood",
     "RaiseAt",
     "RankLostError",
+    "ReplicaKill",
     "SlowConsumer",
     "SpikeAt",
     "StallAt",
     "TornCheckpoint",
+    "UnhealthyPromotion",
     "active_plan",
     "lost_ranks",
     "maybe_fire",
@@ -101,6 +103,15 @@ CHAOS_SITES = {
     "serve/infer": (
         "ServeEngine batcher, inside the backend-call span — where "
         "SlowConsumer wedges the backend under the serve watchdog lease"
+    ),
+    "fleet/replica": (
+        "ReplicaSet monitor tick (ctx: fleet, replicas — the live slots) "
+        "— where ReplicaKill yanks one supervised serving replica"
+    ),
+    "fleet/promote": (
+        "ReplicaSet.promote, before the health-stamp gate (ctx: fleet, "
+        "candidate — a mutable gate dict) — where UnhealthyPromotion "
+        "taints the candidate the gate must refuse"
     ),
 }
 
@@ -445,6 +456,66 @@ class PreemptNotice(Injector):
         if watcher is None:
             watcher = preempt.install()
         watcher.request("chaos:PreemptNotice")
+
+
+class ReplicaKill(Injector):
+    """Kill one live serving replica out from under the fleet — the
+    listener refuses new connections and the serve loop crashes with a
+    :class:`ChaosError` (retryable, so the slot's supervisor rebuilds it
+    warm).  The contract under test: the router rotates around the hole
+    within the detection window, clients see retries not 5xx, and the
+    rebuilt replica re-admits only after ``/healthz`` goes green.  Fires
+    at ``fleet/replica`` (ctx carries ``replicas``, the live slots);
+    ``step`` counts monitor ticks."""
+
+    def __init__(self, step: int | None = None, *, replica: int = 0,
+                 site: str = "fleet/replica", times: int = 1):
+        super().__init__(site, step, times=times)
+        self.replica = int(replica)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        replicas = ctx.get("replicas")
+        if not replicas:
+            raise ValueError(
+                f"ReplicaKill fired at site {self.site!r} with no live "
+                "replicas — schedule it at the 'fleet/replica' site of a "
+                "started ReplicaSet"
+            )
+        slot = replicas[self.replica % len(replicas)]
+        slot.kill(ChaosError(
+            f"chaos: ReplicaKill took replica {slot.idx} "
+            f"(gen {slot.gen}) at tick {ctx.get('step')}"
+        ))
+
+    def describe(self) -> str:
+        return (f"ReplicaKill(replica={self.replica}, site={self.site!r}, "
+                f"step={self.step})")
+
+
+class UnhealthyPromotion(Injector):
+    """Taint the promotion candidate — the deterministic stand-in for a
+    dirty health stamp discovered at promotion time.  The contract under
+    test: :meth:`ReplicaSet.promote` refuses loudly
+    (:class:`~tpuframe.serve.fleet.PromotionRefused` + one
+    ``fleet/promotion_refused`` event) and the old model keeps serving.
+    Fires at ``fleet/promote`` (ctx carries ``candidate``, a mutable
+    gate dict); ``step`` counts promotion attempts at that fleet."""
+
+    def __init__(self, step: int | None = None, *, site: str = "fleet/promote",
+                 times: int = 1):
+        super().__init__(site, step, times=times)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        candidate = ctx.get("candidate")
+        if candidate is None:
+            raise ValueError(
+                f"UnhealthyPromotion fired at site {self.site!r} which "
+                "carries no promotion candidate — schedule it at the "
+                "'fleet/promote' site"
+            )
+        candidate["taint"] = (
+            "chaos: UnhealthyPromotion drill (dirty health stamp)"
+        )
 
 
 class ChaosPlan:

@@ -12,6 +12,11 @@ Two halves:
   explicit shed verdicts, door-side poison-input validation, graceful
   SIGTERM drain, and a watchdog lease on every backend call.  SERVE.md
   is the runbook.
+- **Fleet** (:class:`Router` / :class:`ReplicaSet`): N supervised
+  replicas behind a health-aware least-loaded router, with zero-drop
+  rolling checkpoint promotion (:meth:`ReplicaSet.promote`) gated on
+  the checkpoint health stamp and a shadow-replica accuracy/latency
+  check.  SERVE.md "Fleet" section is the runbook.
 
 Exports are lazy (PEP 562): the knob list / admission policy / artifact
 header reader stay importable while the jax backend is wedged — the
@@ -23,9 +28,13 @@ doctor and the remote launcher depend on that.
 _LAZY = {
     "AdmissionController": "tpuframe.serve.admission",
     "ExportedModel": "tpuframe.serve.export",
+    "FleetKnobs": "tpuframe.serve.router",
     "InvalidRequest": "tpuframe.serve.admission",
+    "PromotionRefused": "tpuframe.serve.fleet",
+    "ReplicaSet": "tpuframe.serve.fleet",
     "RequestRejected": "tpuframe.serve.admission",
     "RequestShed": "tpuframe.serve.admission",
+    "Router": "tpuframe.serve.router",
     "SERVE_ENV_VARS": "tpuframe.serve.admission",
     "ServeEngine": "tpuframe.serve.engine",
     "ServeKnobs": "tpuframe.serve.admission",

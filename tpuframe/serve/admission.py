@@ -69,6 +69,13 @@ SERVE_ENV_VARS = (
     "TPUFRAME_SERVE_MAX_PIXELS",
     "TPUFRAME_SERVE_WATCHDOG_S",
     "TPUFRAME_SERVE_EXPORT",
+    # fleet layer (read by serve.router.FleetKnobs.from_env)
+    "TPUFRAME_ROUTER_PROBE_MS",
+    "TPUFRAME_ROUTER_RETRIES",
+    "TPUFRAME_ROUTER_RETRY_BUDGET",
+    "TPUFRAME_FLEET_REPLICAS",
+    "TPUFRAME_FLEET_SHADOW_REQUESTS",
+    "TPUFRAME_FLEET_GATE_AGREEMENT",
 )
 
 #: value domains for the knobs above (KN007).  ``apply``: buckets /
@@ -92,6 +99,19 @@ SERVE_ENV_DOMAINS = {
     "TPUFRAME_SERVE_WATCHDOG_S": {
         "type": "float", "range": (0, None), "apply": "live"},
     "TPUFRAME_SERVE_EXPORT": {"type": "path", "apply": "live"},
+    # fleet knobs shape the router/replica-set at construction -> restart
+    "TPUFRAME_ROUTER_PROBE_MS": {
+        "type": "float", "range": (1.0, None), "apply": "restart"},
+    "TPUFRAME_ROUTER_RETRIES": {
+        "type": "int", "range": (0, 8), "apply": "restart"},
+    "TPUFRAME_ROUTER_RETRY_BUDGET": {
+        "type": "float", "range": (0, 1.0), "apply": "restart"},
+    "TPUFRAME_FLEET_REPLICAS": {
+        "type": "int", "range": (1, 64), "apply": "restart"},
+    "TPUFRAME_FLEET_SHADOW_REQUESTS": {
+        "type": "int", "range": (1, None), "apply": "restart"},
+    "TPUFRAME_FLEET_GATE_AGREEMENT": {
+        "type": "float", "range": (0, 1.0), "apply": "restart"},
 }
 
 #: pixel budget default — PIL's ``MAX_IMAGE_PIXELS`` (the same ceiling

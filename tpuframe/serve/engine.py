@@ -174,9 +174,13 @@ class ServeEngine:
         item_shape: tuple | None = None,
         dtype: Any = None,
         preemption: bool = True,
+        replica: int | str | None = None,
     ):
         self.knobs = knobs or ServeKnobs.from_env()
         self.preemption = preemption
+        # fleet identity: when set, every serve/request event carries it
+        # so the analyzer can break serve_latency out per replica
+        self.replica = replica
         meta = getattr(model, "meta", None)
         if item_shape is None and isinstance(meta, dict):
             item_shape = tuple(meta["input_shape"][1:])
@@ -577,6 +581,8 @@ class ServeEngine:
                 self._c_served.inc()
                 tele.event("serve/request", latency_s=round(lat, 6),
                            batch=bidx, verdict="ok",
+                           **({"replica": self.replica}
+                              if self.replica is not None else {}),
                            **({"synthetic": True} if r.synthetic else {}))
                 if r.res is not None:
                     r.res._complete(out[i], "ok", lat)

@@ -1,0 +1,477 @@
+"""Supervised replica sets + zero-drop checkpoint promotion.
+
+The fleet layer over the serving spine: N :class:`ServeEngine` +
+:class:`ServingServer` replicas behind one :class:`Router`, each replica
+owned by a per-slot :class:`~tpuframe.fault.supervisor.Supervisor` so the
+fleet heals itself with the same budgets/backoff/classification
+discipline as the training loop:
+
+- **Lifecycle.**  A chaos-killed or wedged replica's serve loop raises;
+  the slot's supervisor classifies it (``ChaosError`` → retryable),
+  backs off, and rebuilds the replica **warm** — the supervisor enables
+  the persistent compile cache before attempt 1, and the rebuilt
+  engine's AOT bucket precompile reads every program back instead of
+  recompiling.  The replica re-enters routing only after its own
+  ``/healthz`` answers green (the re-admission gate), so the router
+  never routes into a replica that is still compiling.
+- **Promotion** (:meth:`ReplicaSet.promote`).  A candidate model is
+  swapped in only after two gates: its checkpoint health stamp must be
+  clean (:func:`tpuframe.ckpt.meta.ckpt_health_verdict` — strict:
+  meta refuses loudly, it never crashes the router on a corrupt
+  candidate), and a **shadow replica** must pass the accuracy/latency
+  gate against live-mirrored traffic (the router's recent-payload ring
+  replayed through the shadow engine and a live replica; argmax
+  agreement ≥ ``TPUFRAME_FLEET_GATE_AGREEMENT``, shadow p95 under the
+  SLO).  Then replicas swap **one at a time** through the existing
+  drain machinery: rotate out of the router, drain (every admitted
+  request completes — ``dropped_in_flight`` is counted and must be 0),
+  rebuild on the candidate, re-admit on green.  A refused promotion is
+  one loud ``fleet/promotion_refused`` event + :class:`PromotionRefused`
+  — the old model keeps serving.
+
+Chaos drives both stories deterministically: ``ReplicaKill`` fires at
+the ``fleet/replica`` site (the supervisor tick), ``UnhealthyPromotion``
+taints the candidate at ``fleet/promote`` (see FAULT.md).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+import urllib.request
+from typing import Any
+
+from tpuframe.ckpt.meta import ckpt_health_verdict
+from tpuframe.fault import chaos
+from tpuframe.fault.supervisor import RestartPolicy, Supervisor
+from tpuframe.serve.admission import ServeKnobs
+from tpuframe.serve.router import FleetKnobs, Router
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = ["PromotionRefused", "ReplicaSet"]
+
+
+class PromotionRefused(RuntimeError):
+    """The promotion gate said no — dirty/unreadable health stamp, chaos
+    taint, or a failed shadow accuracy/latency gate.  The old model keeps
+    serving; the reason is in the message and the
+    ``fleet/promotion_refused`` event."""
+
+
+class _Slot:
+    """One replica slot: the persistent identity a supervisor keeps
+    rebuilding attempts into.  Mutable attempt state under ``lock``."""
+
+    def __init__(self, idx: int, model: Any):
+        self.idx = idx
+        self.model = model
+        self.gen = 1
+        self.lock = threading.Lock()
+        self.engine: Any = None
+        self.server: Any = None
+        self.url: str | None = None
+        self.dead = threading.Event()
+        self.error: BaseException | None = None
+        self.shutdown = False
+
+    def alive(self) -> bool:
+        with self.lock:
+            return self.url is not None and not self.dead.is_set()
+
+    def kill(self, error: BaseException) -> None:
+        """Abrupt replica death (the ``ReplicaKill`` injector's hook):
+        yank the HTTP listener so new connections refuse, record the
+        failure, and wake the serve loop to crash with it."""
+        with self.lock:
+            if self.dead.is_set():
+                return
+            self.error = error
+            srv = self.server
+        if srv is not None:
+            try:
+                srv._server.shutdown()
+                srv._server.server_close()
+            except Exception:
+                pass
+        self.dead.set()
+
+    def retire(self) -> None:
+        """Graceful attempt end (swap/shutdown): no error recorded, the
+        serve loop drains and either rebuilds (swap) or returns."""
+        with self.lock:
+            self.error = None
+        self.dead.set()
+
+
+class ReplicaSet:
+    """N supervised serving replicas behind a least-loaded router.
+
+    Args:
+      model: what each replica serves — an
+        :class:`~tpuframe.serve.export.ExportedModel` or a jit-able
+        callable (plain callables also need ``item_shape``/``dtype``,
+        exactly like :class:`ServeEngine`).
+      n: fleet size (default ``TPUFRAME_FLEET_REPLICAS``).
+      serve_knobs / fleet_knobs: per-replica engine policy and
+        router/fleet policy (default: from env).
+
+    ``start()`` brings the router and every replica up;
+    ``router.url + "/predict"`` is the fleet's front door.
+    Context-managed: ``with ReplicaSet(model, n=3) as fleet: ...``.
+    """
+
+    def __init__(self, model: Any, n: int | None = None, *,
+                 serve_knobs: ServeKnobs | None = None,
+                 fleet_knobs: FleetKnobs | None = None,
+                 item_shape: tuple | None = None, dtype: Any = None,
+                 host: str = "127.0.0.1",
+                 restart_policy: RestartPolicy | None = None):
+        self.knobs = fleet_knobs or FleetKnobs.from_env()
+        self.serve_knobs = serve_knobs or ServeKnobs.from_env()
+        self.n = int(n) if n is not None else self.knobs.replicas
+        self._model = model
+        self._item_shape = item_shape
+        self._dtype = dtype
+        self._host = host
+        # replica restarts are local rebuilds, not cross-host reschedules:
+        # short backoff, generous retryable budget (each chaos kill is one
+        # RETRYABLE failure; a fleet drill kills more than twice)
+        self._policy = restart_policy or RestartPolicy(
+            max_restarts=8, backoff_base_s=0.05, backoff_max_s=0.5,
+        )
+        self.router = Router(knobs=self.knobs, host=host)
+        self._slots: list[_Slot] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._monitor: threading.Thread | None = None
+        self._tick = 0
+        self._promote_attempts = 0
+        self._promote_lock = threading.Lock()
+        reg = get_telemetry().registry
+        self._c_restarts = reg.counter("fleet/restarts")
+        self._c_promotions = reg.counter("fleet/promotions")
+        self._c_refused = reg.counter("fleet/promotions_refused")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, wait_s: float = 30.0) -> "ReplicaSet":
+        """Start the router, spawn every supervised replica, and wait
+        until the whole fleet is green (raises on timeout — a fleet that
+        can't come up should fail loudly, not serve at half strength)."""
+        if self._threads:
+            return self
+        self.router.start()
+        for i in range(self.n):
+            slot = _Slot(i, self._model)
+            self._slots.append(slot)
+            t = threading.Thread(
+                target=self._supervise_slot, args=(slot,),
+                name=f"tpuframe-fleet-replica{i}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+        deadline = time.monotonic() + wait_s
+        while len(self.router.healthy_backends()) < self.n:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet failed to come up: "
+                    f"{len(self.router.healthy_backends())}/{self.n} "
+                    f"replicas green after {wait_s}s"
+                )
+            time.sleep(0.01)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="tpuframe-fleet-monitor",
+            daemon=True,
+        )
+        self._monitor.start()
+        get_telemetry().event(
+            "fleet/started", replicas=self.n, router=self.router.url,
+        )
+        return self
+
+    def __enter__(self) -> "ReplicaSet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        for slot in self._slots:
+            slot.shutdown = True
+            slot.retire()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self.router.close()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def generation(self) -> int:
+        """The model generation every replica has reached (bumps on a
+        completed promotion)."""
+        return min((s.gen for s in self._slots), default=1)
+
+    def replica_urls(self) -> list[str]:
+        return [s.url for s in self._slots if s.url is not None]
+
+    # -- the per-slot supervised serve loop ----------------------------------
+    def _supervise_slot(self, slot: _Slot) -> None:
+        sup = Supervisor(
+            self._policy,
+            on_restart=lambda attempt, e: self._c_restarts.inc(),
+        )
+        try:
+            sup.run(lambda: self._slot_body(slot))
+        except BaseException:
+            # budget exhausted or fatal: the slot stays down; the router
+            # has already rotated it out and the gauge shows the hole
+            if not slot.shutdown:
+                get_telemetry().event(
+                    "fleet/replica_down", url=slot.url or f"slot{slot.idx}",
+                    via="supervisor-giveup",
+                )
+
+    def _slot_body(self, slot: _Slot) -> None:
+        """One supervised run: serve attempts until shutdown.  A kill
+        raises out to the supervisor (classify → backoff → re-entry);
+        a graceful retire loops straight into the next generation."""
+        while not slot.shutdown:
+            self._run_attempt(slot)
+        return None
+
+    def _run_attempt(self, slot: _Slot) -> None:
+        from tpuframe.serve.engine import ServeEngine
+        from tpuframe.serve.server import ServingServer
+
+        engine = ServeEngine(
+            slot.model, knobs=self.serve_knobs,
+            item_shape=self._item_shape, dtype=self._dtype,
+            replica=slot.idx,
+        )
+        engine.start()  # AOT bucket precompile — warm off the shared cache
+        server = ServingServer(engine, host=self._host, port=0)
+        url = server.url
+        # re-admission gate: the replica enters routing only after its
+        # own /healthz answers green over real HTTP
+        self._wait_green(url, timeout_s=10.0)
+        with slot.lock:
+            slot.engine, slot.server, slot.url = engine, server, url
+            slot.error = None
+            slot.dead = threading.Event()
+        self.router.add_backend(url)
+        slot.dead.wait()
+        self.router.remove_backend(url)
+        err = slot.error
+        if err is not None:
+            # crashed attempt: queued work sheds (the kill's collateral —
+            # the router's retry budget covers the clients), then the
+            # supervisor takes it from here
+            with slot.lock:
+                slot.engine = slot.server = slot.url = None
+            try:
+                engine.stop()
+                server.close()
+            except Exception:
+                pass
+            raise err
+        # graceful retire (swap/shutdown): every admitted request
+        # completes before the replica goes away
+        engine.drain(timeout=30.0)
+        with slot.lock:
+            slot.engine = slot.server = slot.url = None
+        engine.stop()
+        server.close()
+
+    @staticmethod
+    def _wait_green(url: str, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url + "/healthz", timeout=1.0) as r:
+                    import json as _json
+
+                    doc = _json.loads(r.read().decode())
+                if doc.get("status") == "ok" and not doc.get("draining"):
+                    return
+            except Exception:
+                pass
+            time.sleep(0.01)
+        raise TimeoutError(f"replica at {url} never went green")
+
+    # -- chaos tick ----------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        interval = self.knobs.probe_ms / 1e3
+        while not self._stop.wait(interval):
+            self._tick += 1
+            live = [s for s in self._slots if s.alive()]
+            chaos.maybe_fire(
+                "fleet/replica", self._tick, fleet=self, replicas=live,
+            )
+
+    # -- promotion -----------------------------------------------------------
+    def promote(self, model: Any, *, ckpt_dir: str | None = None,
+                step: int | None = None, timeout_s: float = 60.0) -> dict:
+        """Swap ``model`` into every replica, zero-drop, or refuse loudly.
+
+        Gate 1 — health stamp: with ``ckpt_dir`` given, the candidate
+        step's stamp must be clean (strict
+        :func:`~tpuframe.ckpt.meta.ckpt_health_verdict`: a dirty stamp,
+        uncommitted step, or unreadable/corrupt meta refuses — it never
+        crashes the router).  Gate 2 — shadow replica: the candidate
+        serves the router's live-mirrored payloads next to a live
+        replica; argmax agreement and shadow p95 latency must clear the
+        knobs.  Then a rolling swap through the drain machinery, one
+        replica at a time.
+
+        Returns ``{"swapped", "dropped_in_flight", "agreement",
+        "shadow_p95_ms", "generation"}``.  Raises
+        :class:`PromotionRefused` (and the old model keeps serving) on
+        any gate failure.
+        """
+        tele = get_telemetry()
+        with self._promote_lock:
+            attempt = self._promote_attempts
+            self._promote_attempts += 1
+            candidate = {"ckpt_dir": ckpt_dir, "step": step}
+            chaos.maybe_fire(
+                "fleet/promote", attempt, fleet=self, candidate=candidate,
+            )
+            taint = candidate.get("taint")
+            if taint:
+                self._refuse(str(taint))
+            if ckpt_dir is not None:
+                ok, reason = ckpt_health_verdict(ckpt_dir, step)
+                if not ok:
+                    self._refuse(f"health stamp: {reason}")
+            agreement, p95_ms = self._shadow_gate(model)
+            if agreement < self.knobs.gate_agreement:
+                self._refuse(
+                    f"shadow gate: agreement {agreement:.3f} < "
+                    f"{self.knobs.gate_agreement} against live traffic"
+                )
+            if p95_ms > self.serve_knobs.slo_ms:
+                self._refuse(
+                    f"shadow gate: p95 {p95_ms:.1f}ms over the "
+                    f"{self.serve_knobs.slo_ms}ms SLO"
+                )
+            # both gates green: rolling swap, one replica at a time
+            dropped = 0
+            swapped = 0
+            for slot in self._slots:
+                dropped += self._swap_slot(slot, model, timeout_s)
+                swapped += 1
+                tele.event(
+                    "fleet/swap", replica=slot.idx, gen=slot.gen,
+                    dropped_in_flight=dropped,
+                )
+            self._model = model
+            self._c_promotions.inc()
+            tele.event(
+                "fleet/promoted", replicas=swapped,
+                dropped_in_flight=dropped,
+                agreement=round(agreement, 4),
+                shadow_p95_ms=round(p95_ms, 3),
+                ckpt_dir=ckpt_dir, step=step,
+            )
+            return {
+                "swapped": swapped,
+                "dropped_in_flight": dropped,
+                "agreement": round(agreement, 4),
+                "shadow_p95_ms": round(p95_ms, 3),
+                "generation": self.generation,
+            }
+
+    def _refuse(self, reason: str) -> None:
+        self._c_refused.inc()
+        get_telemetry().event("fleet/promotion_refused", reason=reason)
+        raise PromotionRefused(f"promotion refused: {reason}")
+
+    def _swap_slot(self, slot: _Slot, model: Any, timeout_s: float) -> int:
+        """Drain-swap one replica onto ``model``; returns how many
+        admitted requests failed to complete (must be 0)."""
+        old_engine = slot.engine
+        old_url = slot.url
+        slot.model = model
+        slot.gen += 1
+        if old_url is not None:
+            # rotate out FIRST so no new request lands mid-drain
+            self.router.remove_backend(old_url)
+        dropped = 0
+        if old_engine is not None:
+            ok = old_engine.drain(timeout=timeout_s)
+            dropped = old_engine.queue_depth() if not ok else 0
+        slot.retire()  # the serve loop rebuilds on the new generation
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with slot.lock:
+                fresh = (slot.url is not None and not slot.dead.is_set()
+                         and slot.engine is not None)
+            if fresh and slot.url in self.router.healthy_backends():
+                return dropped
+            time.sleep(0.01)
+        raise RuntimeError(
+            f"replica {slot.idx} never came back green after swap "
+            f"(gen {slot.gen})"
+        )
+
+    # -- shadow gate ---------------------------------------------------------
+    def _mirrored_payloads(self) -> list:
+        import numpy as np
+
+        ref = self._ref_engine()
+        shape, dtype = ref.item_shape, ref.dtype
+        payloads = []
+        for raw in self.router.recent_payloads()[-self.knobs.shadow_requests:]:
+            try:
+                arr = np.load(io.BytesIO(raw), allow_pickle=False)
+            except Exception:
+                continue
+            if tuple(arr.shape) == tuple(shape):
+                payloads.append(np.asarray(arr, dtype=dtype))
+        while len(payloads) < self.knobs.shadow_requests:
+            payloads.append(np.zeros(shape, dtype))  # cold-fleet filler
+        return payloads
+
+    def _ref_engine(self):
+        for slot in self._slots:
+            with slot.lock:
+                if slot.engine is not None and not slot.dead.is_set():
+                    return slot.engine
+        raise PromotionRefused(
+            "promotion refused: no live replica to mirror traffic against"
+        )
+
+    def _shadow_gate(self, model: Any) -> tuple[float, float]:
+        """(argmax agreement fraction, shadow p95 ms) of the candidate
+        vs a live replica over the mirrored payload set."""
+        import numpy as np
+
+        from tpuframe.serve.engine import ServeEngine
+
+        payloads = self._mirrored_payloads()
+        shadow = ServeEngine(
+            model, knobs=self.serve_knobs,
+            item_shape=self._item_shape, dtype=self._dtype,
+            preemption=False, replica="shadow",
+        )
+        shadow.start()
+        try:
+            agree = 0
+            lats: list[float] = []
+            for p in payloads:
+                ref = self._ref_engine()
+                s_res = shadow.submit(p)
+                r_res = ref.submit(p)
+                s_out = np.asarray(s_res.result(timeout=30.0))
+                r_out = np.asarray(r_res.result(timeout=30.0))
+                if int(np.argmax(s_out)) == int(np.argmax(r_out)):
+                    agree += 1
+                lats.append(float(s_res.latency_s or 0.0))
+        finally:
+            shadow.drain(timeout=10.0)
+            shadow.stop()
+        lats.sort()
+        p95 = lats[min(len(lats) - 1, int(0.95 * len(lats)))] if lats else 0.0
+        return agree / max(1, len(payloads)), p95 * 1e3

@@ -1,0 +1,458 @@
+"""Health-aware HTTP router/load-balancer over N serving replicas.
+
+One replica wedging, draining, or dying must read as a blip, not an
+outage: the router fronts N :class:`~tpuframe.serve.server.ServingServer`
+replicas and keeps the fleet answering while individual replicas come
+and go.  Three mechanisms, all bounded:
+
+- **Least-loaded routing.**  A probe thread scrapes every replica's
+  ``/healthz`` (the ``draining`` + ``queue_depth`` fields the server
+  publishes for exactly this consumer) and ``/metrics`` (the
+  ``serve/queue_depth`` gauge as fallback when an older replica's health
+  body lacks the field) every ``TPUFRAME_ROUTER_PROBE_MS``.  Requests go
+  to the healthy, non-draining replica with the lowest score —
+  queue depth plus an EWMA of the latency the router itself observed
+  against that backend.
+- **Health rotation within a bounded window.**  A replica that fails a
+  probe (connection refused, non-200, draining) leaves rotation on the
+  next tick — detection is bounded by one probe interval — and an
+  in-band forwarding failure marks it down *immediately*, so the window
+  never waits on the prober.  It re-enters only after ``/healthz`` goes
+  green again.
+- **Bounded retry with a budget.**  Connection-refused / 5xx / 429 from
+  one replica retries on the next-best *other* replica, at most
+  ``TPUFRAME_ROUTER_RETRIES`` times — and only while total retries stay
+  under ``TPUFRAME_ROUTER_RETRY_BUDGET`` × total requests.  A sick fleet
+  therefore degrades to honest shedding (503 + ``Retry-After``), never a
+  retry storm that finishes off the survivors.
+
+The router also keeps a small ring of recent request bodies —
+``recent_payloads()`` — which is the live-mirrored traffic
+:meth:`tpuframe.serve.fleet.ReplicaSet.promote` replays through a shadow
+replica's accuracy/latency gate.
+
+Stdlib-only (urllib + http.server + threading), like the server it
+fronts: the fleet's front door must keep routing while the jax backend
+of any one replica is wedged.
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from tpuframe.fault.health import _env_float, _env_int
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = ["FleetKnobs", "Router"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetKnobs:
+    """Router + fleet policy, env-tunable via ``TPUFRAME_ROUTER_*`` /
+    ``TPUFRAME_FLEET_*`` (declared in
+    :data:`~tpuframe.serve.admission.SERVE_ENV_VARS`, shipped by
+    ``launch.remote.all_env_vars()``, printed by the doctor's ``fleet``
+    section).
+
+    Attributes:
+      probe_ms: health/load probe cadence — the routing detection window
+        is bounded by one probe interval (in-band failures mark a
+        replica down faster).
+      retries: max *other* replicas tried per request on
+        connection-refused/5xx/429 before giving the client the verdict.
+      retry_budget: global retries-per-request ratio cap.  Past it the
+        router stops retrying (shed, not storm): when most requests need
+        a retry the fleet is sick, and N× traffic amplification would
+        finish it off.
+      replicas: default fleet size (``ReplicaSet``/bench).
+      shadow_requests: how many live-mirrored requests the promotion
+        shadow gate replays (padded with zeros on a cold fleet).
+      gate_agreement: min argmax-agreement fraction between the shadow
+        replica and the serving model for a promotion to pass.
+    """
+
+    probe_ms: float = 50.0
+    retries: int = 2
+    retry_budget: float = 0.2
+    replicas: int = 3
+    shadow_requests: int = 32
+    gate_agreement: float = 0.99
+
+    @classmethod
+    def from_env(cls) -> "FleetKnobs":
+        """Tolerant like every serve knob: malformed env reads as the
+        default — a typo'd knob must not take the fleet's front door
+        down."""
+        d = cls()
+        return cls(
+            probe_ms=max(
+                1.0, _env_float("TPUFRAME_ROUTER_PROBE_MS", d.probe_ms)
+            ),
+            retries=max(0, _env_int("TPUFRAME_ROUTER_RETRIES", d.retries)),
+            retry_budget=min(1.0, max(0.0, _env_float(
+                "TPUFRAME_ROUTER_RETRY_BUDGET", d.retry_budget))),
+            replicas=max(1, _env_int("TPUFRAME_FLEET_REPLICAS", d.replicas)),
+            shadow_requests=max(1, _env_int(
+                "TPUFRAME_FLEET_SHADOW_REQUESTS", d.shadow_requests)),
+            gate_agreement=min(1.0, max(0.0, _env_float(
+                "TPUFRAME_FLEET_GATE_AGREEMENT", d.gate_agreement))),
+        )
+
+
+class _Backend:
+    """Router-side view of one replica (all fields under Router._lock)."""
+
+    __slots__ = ("url", "healthy", "draining", "queue_depth", "ewma_s",
+                 "fails")
+
+    def __init__(self, url: str):
+        self.url = url
+        self.healthy = False     # down until the first green probe
+        self.draining = False
+        self.queue_depth = 0
+        self.ewma_s = 0.0        # router-observed forward latency
+        self.fails = 0
+
+    def score(self) -> float:
+        # queue depth dominates; the latency EWMA breaks ties between
+        # equally-idle replicas toward the one that answers fastest
+        return self.queue_depth + self.ewma_s * 100.0
+
+
+class Router:
+    """Serve ``/predict`` over the healthiest of N replica URLs.
+
+    ``start()`` binds port 0 (real port on ``.port``/``.url``) and
+    launches the probe thread; replicas are added/removed live
+    (``add_backend``/``remove_backend`` — the :class:`ReplicaSet`
+    supervisor drives these around restarts and promotion swaps).
+    """
+
+    #: ring of recent request bodies for promotion's shadow-mirror gate
+    MIRROR_RING = 256
+
+    def __init__(self, backends: list[str] | None = None, *,
+                 knobs: FleetKnobs | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 60.0):
+        self.knobs = knobs or FleetKnobs.from_env()
+        self.request_timeout_s = float(request_timeout_s)
+        self._lock = threading.Lock()
+        self._backends: dict[str, _Backend] = {}
+        for url in backends or []:
+            self._backends[url.rstrip("/")] = _Backend(url.rstrip("/"))
+        self._mirror: collections.deque = collections.deque(
+            maxlen=self.MIRROR_RING
+        )
+        self._stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        reg = get_telemetry().registry
+        self._c_requests = reg.counter("fleet/requests")
+        self._c_retries = reg.counter("fleet/retries")
+        self._c_no_backend = reg.counter("fleet/no_backend")
+        self._g_healthy = reg.gauge("fleet/healthy_replicas")
+        self._g_size = reg.gauge("fleet/size")
+        self._server = None
+        self.host = host
+        self._requested_port = port
+        self.port: int | None = None
+        self.url: str | None = None
+
+    # -- membership ----------------------------------------------------------
+    def add_backend(self, url: str) -> None:
+        url = url.rstrip("/")
+        with self._lock:
+            if url not in self._backends:
+                self._backends[url] = _Backend(url)
+                self._g_size.set(float(len(self._backends)))
+        self._probe_once()  # admit a green replica without waiting a tick
+
+    def remove_backend(self, url: str) -> None:
+        url = url.rstrip("/")
+        with self._lock:
+            self._backends.pop(url, None)
+            self._g_size.set(float(len(self._backends)))
+            self._g_healthy.set(
+                float(sum(1 for b in self._backends.values() if b.healthy))
+            )
+
+    def backends(self) -> list[str]:
+        with self._lock:
+            return list(self._backends)
+
+    def healthy_backends(self) -> list[str]:
+        with self._lock:
+            return [u for u, b in self._backends.items()
+                    if b.healthy and not b.draining]
+
+    def recent_payloads(self) -> list[bytes]:
+        """Recent raw request bodies (``.npy`` blobs) — the mirrored
+        traffic the promotion shadow gate replays."""
+        with self._lock:
+            return list(self._mirror)
+
+    # -- probing -------------------------------------------------------------
+    def _probe_backend(self, b: _Backend) -> tuple[bool, bool, int]:
+        """(healthy, draining, queue_depth) for one replica, from its
+        ``/healthz`` with the ``/metrics`` queue-depth gauge as fallback.
+        Any transport/parse failure reads as unhealthy."""
+        timeout = max(0.05, self.knobs.probe_ms / 1e3)
+        try:
+            with urllib.request.urlopen(
+                b.url + "/healthz", timeout=timeout
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+        except Exception:
+            return False, False, 0
+        draining = bool(doc.get("draining",
+                                doc.get("status") == "draining"))
+        depth = doc.get("queue_depth")
+        if not isinstance(depth, (int, float)):
+            depth = self._scrape_queue_depth(b, timeout)
+        return doc.get("status") in ("ok", "draining"), draining, int(depth)
+
+    def _scrape_queue_depth(self, b: _Backend, timeout: float) -> int:
+        """Fallback load signal: the ``serve/queue_depth`` gauge off the
+        replica's Prometheus ``/metrics`` page."""
+        try:
+            with urllib.request.urlopen(
+                b.url + "/metrics", timeout=timeout
+            ) as resp:
+                text = resp.read().decode()
+        except Exception:
+            return 0
+        for line in text.splitlines():
+            if line.startswith("tpuframe_serve_queue_depth "):
+                try:
+                    return int(float(line.split()[1]))
+                except (IndexError, ValueError):
+                    return 0
+        return 0
+
+    def _probe_once(self) -> None:
+        with self._lock:
+            backends = list(self._backends.values())
+        tele = get_telemetry()
+        for b in backends:
+            healthy, draining, depth = self._probe_backend(b)
+            with self._lock:
+                was = b.healthy
+                b.healthy, b.draining, b.queue_depth = healthy, draining, depth
+                b.fails = 0 if healthy else b.fails + 1
+            if healthy and not was:
+                tele.event("fleet/replica_up", url=b.url)
+            elif was and not healthy:
+                tele.event("fleet/replica_down", url=b.url, via="probe")
+        with self._lock:
+            self._g_healthy.set(
+                float(sum(1 for x in self._backends.values() if x.healthy))
+            )
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.knobs.probe_ms / 1e3):
+            self._probe_once()
+
+    def _mark_down(self, url: str, reason: str) -> None:
+        """In-band failure: rotate the replica out NOW, not at the next
+        probe tick — the detection window must not wait on the prober."""
+        with self._lock:
+            b = self._backends.get(url)
+            if b is None or not b.healthy:
+                return
+            b.healthy = False
+            b.fails += 1
+            self._g_healthy.set(
+                float(sum(1 for x in self._backends.values() if x.healthy))
+            )
+        get_telemetry().event("fleet/replica_down", url=url, via=reason)
+
+    # -- request path --------------------------------------------------------
+    def _pick(self, exclude: set[str]) -> str | None:
+        with self._lock:
+            live = [b for u, b in self._backends.items()
+                    if b.healthy and not b.draining and u not in exclude]
+            if not live:
+                return None
+            return min(live, key=_Backend.score).url
+
+    def _retry_allowed(self) -> bool:
+        # budget: total retries must stay under budget * total requests
+        # (+1 grace so the very first failure may retry)
+        return self._c_retries.value < (
+            self.knobs.retry_budget * self._c_requests.value + 1
+        )
+
+    def _forward(self, url: str, body: bytes, headers: dict,
+                 timeout: float) -> tuple[int, bytes, dict]:
+        req = urllib.request.Request(
+            url + "/predict", data=body, method="POST",
+            headers={"Content-Type": "application/octet-stream", **headers},
+        )
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out = resp.read()
+                code, hdrs = resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            out = e.read()
+            code, hdrs = e.code, dict(e.headers)
+        dt = time.monotonic() - t0
+        with self._lock:
+            b = self._backends.get(url)
+            if b is not None:
+                b.ewma_s = 0.8 * b.ewma_s + 0.2 * dt
+        return code, out, hdrs
+
+    def handle_predict(self, body: bytes,
+                       headers: dict) -> tuple[int, bytes, dict]:
+        """Route one request: least-loaded replica, bounded budgeted
+        retry-on-other for connection-refused/5xx/429.  Returns
+        ``(status, body, relay_headers)``."""
+        self._c_requests.inc()
+        with self._lock:
+            self._mirror.append(body)
+        tried: set[str] = set()
+        attempts = 0
+        last: tuple[int, bytes, dict] | None = None
+        while attempts <= self.knobs.retries:
+            url = self._pick(tried)
+            if url is None:
+                break
+            tried.add(url)
+            try:
+                code, out, hdrs = self._forward(
+                    url, body, headers, self.request_timeout_s
+                )
+            except Exception as e:  # refused/reset/timeout: replica is gone
+                self._mark_down(url, f"forward:{type(e).__name__}")
+                last = None
+            else:
+                relay = {"X-Fleet-Replica": url}
+                if "Retry-After" in hdrs:
+                    relay["Retry-After"] = hdrs["Retry-After"]
+                if code < 500 and code != 429:
+                    return code, out, relay
+                last = (code, out, relay)
+                if code >= 500:
+                    # 5xx: the replica answered but can't serve — rotate
+                    # it out until its next green probe
+                    self._mark_down(url, f"forward:{code}")
+            attempts += 1
+            if attempts > self.knobs.retries or not self._retry_allowed():
+                break
+            self._c_retries.inc()
+        if last is not None:
+            return last  # relay the backend's own verdict (shed, not storm)
+        self._c_no_backend.inc()
+        get_telemetry().event(
+            "fleet/no_backend", tried=len(tried),
+            healthy=len(self.healthy_backends()),
+        )
+        body_out = json.dumps({
+            "error": "no healthy replica available",
+            "verdict": "no-backend",
+        }).encode()
+        return 503, body_out, {
+            "Retry-After": str(max(1, math.ceil(self.knobs.probe_ms / 1e3))),
+        }
+
+    # -- HTTP front ----------------------------------------------------------
+    def start(self) -> "Router":
+        """Bind the front door (port 0 → real port on ``.port``) and
+        start probing.  Idempotent."""
+        if self._server is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        router_self = self
+        registry = get_telemetry().registry
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, headers: dict) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    with router_self._lock:
+                        reps = [{
+                            "url": b.url, "healthy": b.healthy,
+                            "draining": b.draining,
+                            "queue_depth": b.queue_depth,
+                        } for b in router_self._backends.values()]
+                    body = json.dumps({
+                        "status": "ok",
+                        "replicas": reps,
+                        "healthy": sum(1 for r in reps if r["healthy"]),
+                    }).encode()
+                    self._send(200, body, {})
+                elif path == "/metrics":
+                    body = registry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] != "/predict":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n > 0 else b""
+                fwd = {}
+                deadline = self.headers.get("X-Deadline-Ms")
+                if deadline:
+                    fwd["X-Deadline-Ms"] = deadline
+                code, out, hdrs = router_self.handle_predict(body, fwd)
+                self._send(code, out, hdrs)
+
+            def log_message(self, *args):  # requests must not spam stderr
+                pass
+
+        self._server = ThreadingHTTPServer(
+            (self.host, self._requested_port), _Handler
+        )
+        self.port = self._server.server_address[1]
+        self.url = f"http://{self.host}:{self.port}"
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpuframe-fleet-router", daemon=True,
+        )
+        self._http_thread.start()
+        self._probe_once()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="tpuframe-fleet-probe", daemon=True,
+        )
+        self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=2.0)
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._http_thread.join(timeout=2.0)
+            self._server = None

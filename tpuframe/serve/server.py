@@ -10,9 +10,13 @@ a serving box).  Endpoints:
   Responses carry the admission verdict as an HTTP status: 200 served
   (JSON ``{"output": [...], "latency_ms": ...}``), 400 invalid payload,
   429 shed/rejected under load (clients should back off), 503 draining
-  (the replica is going away — retry elsewhere).
-- ``GET /healthz`` — ``{"status": "ok"|"draining", "queue_depth": N}``;
-  a load balancer drops a draining replica from rotation on this.
+  (the replica is going away — retry elsewhere).  429/503 carry a
+  ``Retry-After`` header derived from the current queue depth and batch
+  wait — well-behaved clients back off for roughly one queue-drain
+  instead of hammering a shedding replica.
+- ``GET /healthz`` — ``{"status": "ok"|"draining", "draining": bool,
+  "queue_depth": N}``; a load balancer (the fleet :class:`Router`) drops
+  a draining replica from rotation and least-loads on ``queue_depth``.
 - ``GET /metrics`` — Prometheus text from the process registry (the
   serve histograms/gauges/counters ride the existing telemetry spine).
 
@@ -57,11 +61,14 @@ class ServingServer:
         server_self = self
 
         class _Handler(BaseHTTPRequestHandler):
-            def _send(self, code: int, obj: dict) -> None:
+            def _send(self, code: int, obj: dict,
+                      headers: dict | None = None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -81,6 +88,7 @@ class ServingServer:
                     eng = server_self.engine
                     self._send(200, {
                         "status": "draining" if eng.draining else "ok",
+                        "draining": bool(eng.draining),
                         "queue_depth": eng.queue_depth(),
                     })
                 else:
@@ -123,9 +131,11 @@ class ServingServer:
                     self._send(400, {"error": str(e), "verdict": "invalid"})
                 except RequestRejected as e:
                     code = 503 if e.verdict == "rejected-draining" else 429
-                    self._send(code, {"error": str(e), "verdict": e.verdict})
+                    self._send(code, {"error": str(e), "verdict": e.verdict},
+                               headers=server_self._retry_after())
                 except RequestShed as e:
-                    self._send(429, {"error": str(e), "verdict": e.verdict})
+                    self._send(429, {"error": str(e), "verdict": e.verdict},
+                               headers=server_self._retry_after())
                 except TimeoutError as e:
                     self._send(504, {"error": str(e), "verdict": "timeout"})
                 else:
@@ -147,6 +157,18 @@ class ServingServer:
             name="tpuframe-serve-http", daemon=True,
         )
         self._thread.start()
+
+    def _retry_after(self) -> dict:
+        """``Retry-After`` for a shedding/draining reply: roughly one
+        queue-drain from now — queued items over the largest batch shape,
+        one batch wait each — clamped to [1, 30] s.  An estimate to space
+        client retries out, not a promise of capacity."""
+        import math
+
+        eng = self.engine
+        batches = math.ceil(max(1, eng.queue_depth()) / max(eng.buckets))
+        wait_s = batches * (eng.knobs.batch_wait_ms / 1e3)
+        return {"Retry-After": str(max(1, min(30, math.ceil(wait_s))))}
 
     def run_forever(self, poll_s: float = 0.25) -> None:
         """Block until a preemption notice, then drain gracefully.

@@ -553,12 +553,13 @@ def skew_report(ranks: Sequence[RankLog], *,
     # (ServeEngine emits one serve/request event per served request).
     # Shaped like step_time so baseline_diff gates a p99 latency
     # regression with the same exit-3 discipline as a step-time one.
-    serve_lats = sorted(
-        float(rec["latency_s"])
+    serve_recs = [
+        rec
         for rl in ranks for rec in rl.events
         if rec.get("name") == "serve/request"
         and isinstance(rec.get("latency_s"), (int, float))
-    )
+    ]
+    serve_lats = sorted(float(rec["latency_s"]) for rec in serve_recs)
     serve_latency = None
     if serve_lats:
         serve_latency = {
@@ -568,6 +569,27 @@ def skew_report(ranks: Sequence[RankLog], *,
             "p95": round(_pctl(serve_lats, 0.95), 6),
             "p99": round(_pctl(serve_lats, 0.99), 6),
         }
+        # fleet runs tag each serve/request with the replica that served
+        # it (ServeEngine(replica=...)); break the aggregate out so a
+        # skewed replica is visible, while the gate stays on the
+        # fleet-wide p99 above
+        by_rep: dict = {}
+        for rec in serve_recs:
+            rep = rec.get("replica")
+            if rep is not None:
+                by_rep.setdefault(str(rep), []).append(
+                    float(rec["latency_s"])
+                )
+        if by_rep:
+            serve_latency["replicas"] = len(by_rep)
+            serve_latency["per_replica"] = {
+                rep: {
+                    "count": len(ls),
+                    "p50": round(_pctl(sorted(ls), 0.50), 6),
+                    "p99": round(_pctl(sorted(ls), 0.99), 6),
+                }
+                for rep, ls in sorted(by_rep.items())
+            }
     # comms block: present only when the run declared a wire plan (the
     # compressed train step emits one comms/wire_plan event at build).
     # bytes_per_step is static per signature; the run total multiplies
