@@ -166,6 +166,14 @@ run bench_fault_divergence.json 300 python benchmarks/bench_fault.py --divergenc
 # cheap, so it rides with the fault rung above the long tail
 run analyze_selftest.json      300  python benchmarks/bench_analyze.py
 
+# device-time rung: a sampled XLA capture prices itself on the real
+# chip — armed-but-idle per-step tax (the <=2% claim), cost per capture
+# window, parse throughput, and the REAL exposed-comms / device-step
+# numbers the committed device_time block lets `analyze --baseline`
+# gate on (a CPU capture has no device tracks worth believing; this
+# rung is where overlap_efficiency means something)
+run profile_selftest.json      300  python benchmarks/bench_profile.py
+
 # invariant-linter rung: the static pass prices itself (and doubles as
 # the contract gate — a dirty tree exits 3 and the stale artifact is
 # kept).  Host-side work, never on-chip; rides here because it is cheap

@@ -1,0 +1,94 @@
+"""Regenerate the committed golden profiler trace for the device-time parser.
+
+``tests/fixtures/device_trace/`` is a synthetic profiler logdir in the
+TensorBoard layout jax's profiler writes
+(``plugins/profile/<session>/<host>.trace.json.gz`` — Chrome Trace Event
+JSON), sized so every device-time number is exact by hand:
+
+one device track (pid 1, "XLA Ops" thread, all times in µs):
+
+- compute:    ``fusion.1`` [0,100)   ``fusion.2`` [200,300)  ``dot.3`` [400,600)
+- collective: ``all-reduce.1`` [50,150)   ``all-reduce.2`` [600,700)
+- transfer:   ``infeed.1`` [350,400)
+
+so the parser must report (for ``steps=2``):
+
+- compute union 400 µs, collective 200 µs, transfer 50 µs
+- busy 600 µs over a 700 µs span -> idle 100 µs
+- exposed comms = collective − compute = [100,150) ∪ [600,700) = 150 µs
+- overlap_efficiency = 1 − 150/200 = 0.25
+- device_step_s 350 µs, exposed_comms_per_step_s 75 µs
+- top-op totals: fusion 200 (x2), dot 200 (x1), all-reduce 200 (x2),
+  infeed 50 (x1) over a 650 µs op total
+
+and must EXCLUDE, without them perturbing any number above:
+
+- an infra event (``Thunk::Execute``, name contains ``::``) on the exec thread
+- a ``Steps`` thread event on the device pid (double-counts the real ops)
+- a host-process (``/host:CPU``) ``python`` thread event with an inflated
+  duration (CPU traces report these wildly wrong)
+
+The gzip member is written with ``mtime=0`` so regeneration is byte-stable.
+
+Run from the repo root::
+
+    python tests/fixtures/make_device_trace_fixture.py
+"""
+
+import gzip
+import json
+import os
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "device_trace")
+SESSION = "2026_01_01_00_00_00"
+
+TRACE = {
+    "displayTimeUnit": "ns",
+    "traceEvents": [
+        # -- track metadata ----------------------------------------------
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 10, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 1, "tid": 11, "name": "thread_name",
+         "args": {"name": "Steps"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 2, "tid": 20, "name": "thread_name",
+         "args": {"name": "python"}},
+        # -- the real device ops (pid 1 / "XLA Ops") ---------------------
+        {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.1",
+         "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "all-reduce.1",
+         "ts": 50, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.2",
+         "ts": 200, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "infeed.1",
+         "ts": 350, "dur": 50},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "dot.3",
+         "ts": 400, "dur": 200},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "all-reduce.2",
+         "ts": 600, "dur": 100},
+        # -- noise the parser must ignore --------------------------------
+        {"ph": "X", "pid": 1, "tid": 10, "name": "Thunk::Execute",
+         "ts": 0, "dur": 700},
+        {"ph": "X", "pid": 1, "tid": 11, "name": "step 1",
+         "ts": 0, "dur": 700},
+        {"ph": "X", "pid": 2, "tid": 20, "name": "python busy",
+         "ts": 0, "dur": 999999},
+    ],
+}
+
+
+def main() -> None:
+    session_dir = os.path.join(OUT, "plugins", "profile", SESSION)
+    os.makedirs(session_dir, exist_ok=True)
+    path = os.path.join(session_dir, "fixture.trace.json.gz")
+    payload = json.dumps(TRACE, sort_keys=True).encode()
+    with open(path, "wb") as f:
+        f.write(gzip.compress(payload, mtime=0))
+    print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

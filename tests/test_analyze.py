@@ -329,6 +329,104 @@ class TestStepWallStructuralGuards:
         assert rows[1]["max_s"] == pytest.approx(0.104, rel=0.01)
 
 
+# -- device-time attribution --------------------------------------------------
+
+
+DEVICE_FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "device_trace")
+
+
+def _capture_event(end, *, dir=DEVICE_FIXTURE, steps=2, partial=False):
+    return {"ts": end, "mono": end, "pid": 100, "kind": "event",
+            "name": "profile/capture", "dir": dir, "steps": steps,
+            "bytes": 307, "partial": partial,
+            "wall_start": end - 0.05, "mono_start": end - 0.05}
+
+
+class TestDeviceTime:
+    """The analyzer side of the parsed-capture path: a ``profile/capture``
+    event pointing at the committed golden trace becomes the report's
+    ``device_time`` block, the baseline gate, and merged Perfetto device
+    tracks."""
+
+    def _dir(self, tmp_path, **kw):
+        return _mklog(tmp_path, [
+            _step(0, 100.0), _step(1, 100.2), _capture_event(100.3, **kw),
+        ])
+
+    def test_skew_report_attaches_the_parsed_block(self, tmp_path):
+        dt = A.skew_report(A.load_dir(self._dir(tmp_path)))["device_time"]
+        assert dt is not None
+        assert dt["rank"] == 0 and dt["captures"] == 1
+        assert dt["partial"] is False and dt["steps"] == 2
+        assert dt["exposed_comms_s"] == pytest.approx(150e-6)
+        assert dt["exposed_comms_per_step_s"] == pytest.approx(75e-6)
+        assert dt["overlap_efficiency"] == pytest.approx(0.25)
+
+    def test_fixture_fleet_has_no_block(self):
+        # no capture ran: the key is present (contract), the value None
+        assert A.skew_report(A.load_dir(FIXTURE))["device_time"] is None
+
+    def test_rotated_away_capture_reads_as_no_block(self, tmp_path):
+        d = self._dir(tmp_path, dir=str(tmp_path / "gone"))
+        assert A.skew_report(A.load_dir(d))["device_time"] is None
+
+    def test_report_text_prints_the_top_op_table(self, tmp_path):
+        report = A.skew_report(A.load_dir(self._dir(tmp_path)))
+        text = A.format_report(report)
+        assert "device time (rank 0, 2 step(s), 1 track(s))" in text
+        assert "exposed comms: 0.15ms (0.07ms/step), overlap efficiency 25%" \
+            in text
+        assert "top device ops (the fused-kernel target list):" in text
+        assert "fusion [compute]" in text and "all-reduce [collective]" in text
+
+    def test_trace_merges_device_tracks_under_the_rank_pid(self, tmp_path):
+        trace = A.build_trace(A.load_dir(self._dir(tmp_path)))
+        dev = [e for e in trace["traceEvents"]
+               if str(e.get("cat", "")).startswith("device/")]
+        assert len(dev) == 6  # the fixture's real ops, noise excluded
+        assert {e["tid"] for e in dev} == {1000}  # above host tids
+        host_pids = {e["pid"] for e in trace["traceEvents"]
+                     if e.get("ph") == "X" and not
+                     str(e.get("cat", "")).startswith("device/")}
+        assert {e["pid"] for e in dev} <= host_pids  # same rank timeline
+        assert {e["cat"] for e in dev} == {
+            "device/compute", "device/collective", "device/transfer"}
+        threads = {e["args"]["name"] for e in trace["traceEvents"]
+                   if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert "/device:TPU:0 XLA Ops" in threads
+
+    def test_exposed_comms_regression_exits_3(self, tmp_path, capsys):
+        d = self._dir(tmp_path)
+        base = tmp_path / "results"
+        base.mkdir()
+        (base / "good.json").write_text(json.dumps({
+            # step time NOT regressed — only the device-level exposure is
+            "step_time": {"p50": 0.5, "p95": 0.6},
+            "device_time": {"exposed_comms_per_step_s": 1e-6,
+                            "device_step_s": 350e-6},
+        }))
+        rc = A.main([d, "--report", "--baseline", str(base)])
+        assert rc == 3
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "exposed_comms" in out
+
+    def test_profile_less_run_is_incomparable_not_regressed(self, tmp_path):
+        # current run captured nothing: a baseline WITH device_time must
+        # not flag it (capture off != comms got slower)
+        d = _mklog(tmp_path, [_step(0, 100.0), _step(1, 100.2)])
+        base = tmp_path / "results"
+        base.mkdir()
+        (base / "good.json").write_text(json.dumps({
+            "step_time": {"p50": 0.5, "p95": 0.6},
+            "device_time": {"exposed_comms_per_step_s": 1e-6,
+                            "device_step_s": 1e-6},
+        }))
+        diff = A.baseline_diff(
+            A.skew_report(A.load_dir(d)), str(base))
+        assert diff["baselines"] and not diff["regressions"]
+
+
 # -- baseline diff ------------------------------------------------------------
 
 

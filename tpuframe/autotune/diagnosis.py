@@ -111,6 +111,20 @@ def _classify(report: dict) -> tuple[str, dict]:
         if frac >= _SIGNIFICANT:
             return "comms", detail
 
+    # device-level comms: the parsed profiler capture's exposed-comms
+    # fraction of the device step — the DIRECT measurement (the
+    # allreduce heuristic above only sees standalone/bench collectives;
+    # a fused step's collective is invisible to it but not to the trace)
+    dt = report.get("device_time") or None
+    if dt and (dt.get("device_step_s") or 0) > 0:
+        frac = (
+            (dt.get("exposed_comms_per_step_s") or 0.0)
+            / dt["device_step_s"]
+        )
+        detail["exposed_comms_fraction"] = round(frac, 4)
+        if frac >= _SIGNIFICANT:
+            return "comms", detail
+
     # single-rank fallback: the device waiting on the host IS input-bound
     # even though no step ever "straggles"
     if wait_frac >= _SIGNIFICANT:
@@ -159,19 +173,31 @@ def diagnose(report: dict, *, gauges: dict | None = None) -> Diagnosis:
              "stretch the mid-epoch cadence")
     elif bound == "comms":
         comms = report.get("comms") or {}
+        dt = report.get("device_time") or {}
+        exposed = dt.get("exposed_comms_per_step_s")
+        why_bucket = "comms-bound: larger buckets amortize per-collective latency"
+        if exposed:
+            # the measured number the bucket probe must shrink: exposed
+            # wall, not bytes — overlap is the win on real topology
+            why_bucket = (
+                f"comms-bound: {exposed * 1e3:.2f}ms/step of collective "
+                "wall exposed (not hidden behind compute) — probe bucket "
+                "sizing against overlap"
+            )
         if (comms.get("mode") or "none") in ("none", ""):
             move("TPUFRAME_COMMS_COMPRESSION", "int8",
                  "comms-bound at f32 wire: int8 is ~4x fewer sync bytes")
-        move("TPUFRAME_COMMS_BUCKET_MB", 8.0,
-             "comms-bound: larger buckets amortize per-collective latency")
+        move("TPUFRAME_COMMS_BUCKET_MB", 8.0, why_bucket)
         move("TPUFRAME_GRAD_ACCUM", 2,
              "comms-bound: accumulate micro-batches, sync once per "
              "super-batch")
     elif bound == "compute":
-        # compute-bound is the healthy state; the one knob worth probing
-        # is grad-accum DOWN if someone left it high (covered by restart
-        # config, not a live move) — nothing to do here.
-        pass
+        # compute-bound is the healthy state; no knob move — but when a
+        # parsed capture exists, name WHERE the compute goes (the top-op
+        # table is the fused-kernel target list ROADMAP item 3(b) reads)
+        top = (report.get("device_time") or {}).get("top_ops")
+        if top:
+            detail["top_ops"] = top[:5]
 
     # compile block rides along regardless of bound: a cold compile that
     # dominates the window says the cache/precompiler are off

@@ -366,6 +366,63 @@ def comms_section() -> dict:
     return out
 
 
+def profile_section() -> dict:
+    """State of the device-time capture path (`track/profiler.py` +
+    `track/device_time.py`): the ``TPUFRAME_PROFILE_*`` knobs (malformed
+    values reported, not crashed on), the newest surviving capture dir
+    with its parsed ``device_time`` summary (stdlib gzip+json — works
+    against a wedged backend), and the paste-ready analyze one-liner —
+    so a "my step is slow" report says up front whether on-device
+    evidence exists and what it already attributes."""
+    from tpuframe.track.device_time import (
+        PROFILE_ENV_VARS,
+        device_time_report,
+        list_captures,
+        profile_env,
+    )
+
+    env = profile_env()
+    errors = env.pop("errors")
+    out: dict = {
+        "armed": bool(env["TPUFRAME_PROFILE_STEPS"]),
+        "knobs": env,
+        "env": {
+            k: os.environ[k] for k in PROFILE_ENV_VARS if k in os.environ
+        },
+        "analyze": (
+            "python -m tpuframe.track analyze "
+            "$TPUFRAME_TELEMETRY_DIR --report"
+        ),
+    }
+    if errors:
+        out["errors"] = errors
+    profile_dir = env["TPUFRAME_PROFILE_DIR"]
+    captures = list_captures(profile_dir) if profile_dir else []
+    out["captures"] = len(captures)
+    if captures:
+        newest = captures[-1]
+        out["newest_capture"] = newest
+        try:
+            summary = device_time_report(newest)
+        except (OSError, ValueError) as e:  # torn capture ≠ doctor crash
+            out["parse_error"] = f"{type(e).__name__}: {e}"
+            summary = None
+        if summary is not None:
+            # the headline numbers, not the whole record (top-op table
+            # and per-class breakdown come from the analyze one-liner)
+            out["device_time"] = {
+                "window_s": summary["window_s"],
+                "exposed_comms_s": summary["exposed_comms_s"],
+                "overlap_efficiency": summary["overlap_efficiency"],
+                "device_tracks": summary["device_tracks"],
+                "top_op": (
+                    summary["top_ops"][0]["name"]
+                    if summary["top_ops"] else None
+                ),
+            }
+    return out
+
+
 def autotune_section(devices: dict | None = None) -> dict:
     """State of the self-tuning loop (``tpuframe.autotune``): whether it
     is armed, where the per-``(host, topology, signature)`` configs
@@ -494,6 +551,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "serve": serve_section(export_path),
         "fleet": fleet_section(),
         "comms": comms_section(),
+        "profile": profile_section(),
         "autotune": autotune_section(devices),
         "lint": lint_section(),
         "env": {

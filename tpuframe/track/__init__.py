@@ -32,6 +32,12 @@ _EXPORTS = {
     "build_trace": "analyze",
     "load_trace_dir": "analyze",
     "skew_report": "analyze",
+    "PROFILE_ENV_VARS": "device_time",
+    "PROFILE_ENV_DOMAINS": "device_time",
+    "classify_op": "device_time",
+    "device_time_report": "device_time",
+    "device_trace_events": "device_time",
+    "profile_env": "device_time",
     "ExperimentTracker": "mlflow_store",
     "MLflowLogger": "mlflow_store",
     "Run": "mlflow_store",
@@ -67,6 +73,7 @@ _ALIASES = {"configure_telemetry": "configure", "load_trace_dir": "load_dir"}
 
 _SUBMODULES = (
     "analyze",
+    "device_time",
     "http_store",
     "mlflow_store",
     "profiler",

@@ -58,6 +58,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Iterable, Sequence
 
+from tpuframe.track.device_time import device_time_report, device_trace_events
 from tpuframe.track.telemetry import Histogram, get_telemetry
 
 __all__ = [
@@ -290,6 +291,44 @@ def build_trace(ranks: Sequence[RankLog]) -> dict:
             out.append({"ph": "M", "pid": pid, "tid": tid,
                         "name": "thread_sort_index",
                         "args": {"sort_index": tid}})
+        # device tracks: the rank's newest surviving profiler capture
+        # merges under the SAME pid, so host spans and device ops share
+        # one timeline.  Trace timestamps are µs offsets from capture
+        # start; the profile/capture event recorded that start as a
+        # wall/mono anchor pair, aligned exactly like any other record.
+        cap = None
+        for rec in rl.events:
+            if rec.get("name") == "profile/capture" and rec.get("dir"):
+                if os.path.isdir(str(rec["dir"])):
+                    cap = rec
+        if cap is not None:
+            cap_t0 = rl.end_time({
+                "mono": cap.get("mono_start"),
+                "ts": cap.get("wall_start") or 0.0,
+                "pid": cap.get("pid"),
+            })
+            dev_tids: dict[str, int] = {}
+            for dev_ev in device_trace_events(str(cap["dir"])):
+                key = f"{dev_ev['device']} {dev_ev['thread']}"
+                # device tids live above 1000: no collision with the
+                # appearance-ordered host thread tids
+                tid = dev_tids.setdefault(key, 1000 + len(dev_tids))
+                out.append({
+                    "ph": "X", "pid": pid, "tid": tid,
+                    "name": dev_ev["name"],
+                    "cat": f"device/{dev_ev['class']}",
+                    "ts": round(
+                        (cap_t0 + dev_ev["ts_us"] / 1e6 - t0) * 1e6, 1
+                    ),
+                    "dur": round(dev_ev["dur_us"], 1),
+                    "args": {"class": dev_ev["class"]},
+                })
+            for key, tid in dev_tids.items():
+                out.append({"ph": "M", "pid": pid, "tid": tid,
+                            "name": "thread_name", "args": {"name": key}})
+                out.append({"ph": "M", "pid": pid, "tid": tid,
+                            "name": "thread_sort_index",
+                            "args": {"sort_index": tid}})
     return {
         "traceEvents": out,
         "displayTimeUnit": "ms",
@@ -428,6 +467,40 @@ def _health_info(rl: RankLog) -> dict:
     }
 
 
+def _device_time_info(ranks: Sequence[RankLog]) -> dict | None:
+    """The parsed ``device_time`` block: the NEWEST ``profile/capture``
+    event whose trace dir still exists on disk (the cadence callback
+    rotates old captures away; a one-shot temp capture is zipped into an
+    artifact and its dir deleted — both read as "no parseable capture",
+    not an error).  Parsing is the stdlib gzip+json path in
+    `track/device_time.py` — no jax, so a wedged fleet's capture still
+    attributes."""
+    best: tuple[int, dict] | None = None
+    captures = 0
+    for rl in ranks:
+        for rec in rl.events:
+            if rec.get("name") != "profile/capture":
+                continue
+            captures += 1
+            d = rec.get("dir")
+            if d and os.path.isdir(str(d)):
+                best = (rl.rank, rec)
+    if best is None:
+        return None
+    rank, rec = best
+    try:
+        steps = int(rec.get("steps") or 0) or None
+    except (TypeError, ValueError):
+        steps = None
+    dt = device_time_report(str(rec["dir"]), steps=steps)
+    if dt is None:
+        return None
+    dt["rank"] = rank
+    dt["captures"] = captures
+    dt["partial"] = bool(rec.get("partial"))
+    return dt
+
+
 def _time_to_first_step(rl: RankLog) -> float | None:
     """Seconds from this rank's first telemetry record to the end of its
     first ``train/step`` span — what a cold start actually cost the rank
@@ -457,16 +530,17 @@ def _time_to_first_step(rl: RankLog) -> float | None:
 # below ARE that contract: adding a key is backwards-compatible (bump
 # the minor), removing or renaming one breaks consumers (bump the major
 # and update tpuframe/autotune + the golden structural test together).
-SKEW_REPORT_VERSION = "1.0"
+SKEW_REPORT_VERSION = "1.1"  # 1.1: + device_time (parsed profiler capture)
 
 # Top-level keys, always present (value may be None for the optional
-# blocks: time_to_first_step, health, comms, serve_latency, slowest).
+# blocks: time_to_first_step, health, comms, serve_latency, device_time,
+# slowest).
 SKEW_REPORT_KEYS = (
     "schema_version", "ranks", "hosts", "steps", "warmup_steps_skipped",
     "compile", "time_to_first_step", "health", "straggler_factor",
-    "comms", "serve_latency", "step_time", "step_wall", "total_lost_s",
-    "straggler_lost_s", "straggling_steps", "lost_by_bound", "slowest",
-    "per_rank", "per_step",
+    "comms", "serve_latency", "device_time", "step_time", "step_wall",
+    "total_lost_s", "straggler_lost_s", "straggling_steps",
+    "lost_by_bound", "slowest", "per_rank", "per_step",
 )
 
 # Row contracts for the two per-entity tables.
@@ -684,6 +758,9 @@ def skew_report(ranks: Sequence[RankLog], *,
         "straggler_factor": straggler_factor,
         "comms": comms_info,             # wire traffic (baseline diffs)
         "serve_latency": serve_latency,  # request path (baseline diffs)
+        # parsed profiler capture: per-class device wall, exposed comms,
+        # the top-op table (baseline diffs on exposed/device-step)
+        "device_time": _device_time_info(ranks),
         "step_time": step_time,          # dispatch-only (baseline diffs)
         "step_wall": {                   # boundary-to-boundary
             "p50": round(_pctl(walls, 0.50), 6) if walls else None,
@@ -753,6 +830,12 @@ def baseline_diff(report: dict, baseline: str, *,
     cur_comms = report.get("comms") or {}
     cur_bytes = cur_comms.get("bytes_per_step")
     cur_ar = (cur_comms.get("allreduce_s") or {}).get("p50")
+    cur_dt = report.get("device_time") or {}
+    # per-step values when the capture knew its step count, else the
+    # whole-window values (both sides of a diff commit the same shape)
+    cur_exposed = (cur_dt.get("exposed_comms_per_step_s")
+                   or cur_dt.get("exposed_comms_s"))
+    cur_dstep = cur_dt.get("device_step_s")
     out: dict = {"threshold": threshold, "backend": backend,
                  "baselines": [], "regressions": []}
     for p in paths:
@@ -773,7 +856,13 @@ def baseline_diff(report: dict, baseline: str, *,
         cm = cm if isinstance(cm, dict) and (
             cm.get("bytes_per_step") or (cm.get("allreduce_s") or {}).get("p50")
         ) else None
-        if st is None and tt is None and sv is None and cm is None:
+        dt = rec.get("device_time")
+        dt = dt if isinstance(dt, dict) and (
+            dt.get("exposed_comms_per_step_s") or dt.get("exposed_comms_s")
+            or dt.get("device_step_s")
+        ) else None
+        if st is None and tt is None and sv is None and cm is None \
+                and dt is None:
             continue
         if backend and rec.get("backend") and rec["backend"] != backend:
             continue
@@ -813,6 +902,27 @@ def baseline_diff(report: dict, baseline: str, *,
                 entry["baseline_allreduce_p50_s"] = base_ar
                 entry["current_allreduce_p50_s"] = cur_ar
                 entry["ratio_allreduce_p50"] = round(cur_ar / base_ar, 4)
+        if dt is not None:
+            # device-time regressions gate like step-time ones: comms
+            # time that STOPPED hiding behind compute (exposed grew past
+            # threshold at flat bytes-on-wire) or a slower device step.
+            # A run with NO device_time block — capture off — is
+            # incomparable, not a regression, same discipline as comms.
+            base_exp = (dt.get("exposed_comms_per_step_s")
+                        or dt.get("exposed_comms_s"))
+            if base_exp and cur_exposed:
+                entry["baseline_exposed_comms_s"] = base_exp
+                entry["current_exposed_comms_s"] = cur_exposed
+                entry["ratio_exposed_comms"] = round(
+                    cur_exposed / base_exp, 4
+                )
+            base_dstep = dt.get("device_step_s")
+            if base_dstep and cur_dstep:
+                entry["baseline_device_step_s"] = base_dstep
+                entry["current_device_step_s"] = cur_dstep
+                entry["ratio_device_step"] = round(
+                    cur_dstep / base_dstep, 4
+                )
         out["baselines"].append(entry)
         if (entry.get("ratio_p50") and entry["ratio_p50"] > threshold) or (
             entry.get("ratio_ttfs") and entry["ratio_ttfs"] > threshold
@@ -825,6 +935,12 @@ def baseline_diff(report: dict, baseline: str, *,
         ) or (
             entry.get("ratio_allreduce_p50")
             and entry["ratio_allreduce_p50"] > threshold
+        ) or (
+            entry.get("ratio_exposed_comms")
+            and entry["ratio_exposed_comms"] > threshold
+        ) or (
+            entry.get("ratio_device_step")
+            and entry["ratio_device_step"] > threshold
         ):
             out["regressions"].append(entry)
     return out
@@ -896,6 +1012,45 @@ def format_report(report: dict, diff: dict | None = None, *,
                 if cm.get("allreduce_s") else ""
             )
         )
+    dt = report.get("device_time") or {}
+    if dt:
+        cls = dt.get("classes") or {}
+
+        def _ms(c):
+            return ((cls.get(c) or {}).get("wall_s") or 0.0) * 1e3
+
+        part = "" if not dt.get("partial") else ", partial"
+        lines.append(
+            f"  device time (rank {dt.get('rank')}, "
+            f"{dt.get('steps') or '?'} step(s), "
+            f"{dt.get('device_tracks')} track(s){part}): "
+            f"window={dt['window_s'] * 1e3:.1f}ms "
+            f"compute={_ms('compute'):.1f}ms "
+            f"collective={_ms('collective'):.1f}ms "
+            f"transfer={_ms('transfer'):.1f}ms "
+            f"idle={dt['idle_s'] * 1e3:.1f}ms"
+        )
+        oe = dt.get("overlap_efficiency")
+        exposed = (
+            f"  exposed comms: {dt['exposed_comms_s'] * 1e3:.2f}ms"
+        )
+        if dt.get("exposed_comms_per_step_s") is not None:
+            exposed += (
+                f" ({dt['exposed_comms_per_step_s'] * 1e3:.2f}ms/step)"
+            )
+        if oe is not None:
+            exposed += f", overlap efficiency {oe:.0%}"
+        lines.append(exposed)
+        if dt.get("top_ops"):
+            lines.append(
+                "  top device ops (the fused-kernel target list):"
+            )
+            lines.append("      pct   total_ms  count  op")
+            for op in dt["top_ops"]:
+                lines.append(
+                    f"    {op['pct']:>5.1f} {op['total_s'] * 1e3:>10.2f} "
+                    f"{op['count']:>6}  {op['name']} [{op['class']}]"
+                )
     lines.append(
         f"  time lost to stragglers: {report['straggler_lost_s']:.3f}s "
         f"across {report['straggling_steps']} straggling step(s) "
@@ -975,6 +1130,19 @@ def format_report(report: dict, diff: dict | None = None, *,
                     f"serve_p99 {b['baseline_serve_p99_s'] * 1e3:.1f}ms -> "
                     f"{b['current_serve_p99_s'] * 1e3:.1f}ms "
                     f"(x{b['ratio_serve_p99']:.2f})"
+                )
+            if b.get("ratio_exposed_comms") is not None:
+                parts.append(
+                    f"exposed_comms "
+                    f"{b['baseline_exposed_comms_s'] * 1e3:.2f}ms -> "
+                    f"{b['current_exposed_comms_s'] * 1e3:.2f}ms "
+                    f"(x{b['ratio_exposed_comms']:.2f})"
+                )
+            if b.get("ratio_device_step") is not None:
+                parts.append(
+                    f"device_step {b['baseline_device_step_s'] * 1e3:.2f}ms"
+                    f" -> {b['current_device_step_s'] * 1e3:.2f}ms "
+                    f"(x{b['ratio_device_step']:.2f})"
                 )
             lines.append(
                 f"    vs {b['file']} [{b.get('backend')}]: "

@@ -9,9 +9,21 @@ and the ``nvidia-smi``/screenshot evidence (`/root/reference/README.md:18-20`)
 
 - :func:`trace` — context manager around any region; produces a TensorBoard-
   loadable trace directory (per-op device timeline, HLO, memory viewer).
-- :class:`ProfilerCallback` — Trainer callback that captures steps
-  [skip_steps, skip_steps + num_steps) of the fit, then logs the zipped
-  trace as an artifact to the run (rank-0 only).
+- :class:`ProfilerCallback` — Trainer callback that captures a window of
+  train steps.  Two modes: one-shot (capture steps [skip_steps,
+  skip_steps + num_steps) then log the zipped trace as a run artifact,
+  rank-0 only) and **sampled continuous capture** (``every_steps > 0``:
+  capture ``num_steps`` steps every ``every_steps`` steps into rotated
+  ``capture-b<batch>`` dirs, newest ``keep`` retained — bounded
+  on-device evidence for long runs, armed from the env via
+  :meth:`ProfilerCallback.from_env` / ``TPUFRAME_PROFILE_*``).
+
+Every completed capture emits one ``profile/capture`` telemetry event
+(dir, steps, bytes, the wall/mono anchor pair of its start) and bumps
+the ``profile/captures`` counter — the breadcrumbs
+``tpuframe.track.analyze`` follows to attach a parsed ``device_time``
+block (see `track/device_time.py`) to the skew report and merge device
+ops into the Perfetto timeline.
 
 Per-step wall-clock breakdown (data-wait vs dispatch vs host-block) is
 measured by the Trainer loop itself and reported in every epoch summary —
@@ -38,7 +50,10 @@ def trace(logdir: str):
     """Capture a ``jax.profiler`` trace of the enclosed region to ``logdir``.
 
     The caller is responsible for blocking on async work it wants included
-    (``jax.block_until_ready``) before the region closes.
+    (``jax.block_until_ready``) before the region closes.  The trace is
+    stopped on the error path too — and a stop failure there is swallowed
+    so it can neither mask the real exception nor leave the profiler
+    started and wedge the next capture.
     """
     import jax
 
@@ -46,7 +61,13 @@ def trace(logdir: str):
     jax.profiler.start_trace(logdir)
     try:
         yield logdir
-    finally:
+    except BaseException:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        raise
+    else:
         jax.profiler.stop_trace()
 
 
@@ -54,7 +75,9 @@ def trace_step_window(fn, n_steps: int, logdir: str, *args, **kwargs) -> str:
     """Run ``fn(*args, **kwargs)`` ``n_steps`` times under a trace.
 
     ``fn``'s return value is blocked on each step so device work lands in
-    the trace.  Returns ``logdir``.
+    the trace.  A raising step still closes the trace (see :func:`trace`)
+    — the partial window is real evidence of the step that raised.
+    Returns ``logdir``.
     """
     import jax
 
@@ -65,17 +88,41 @@ def trace_step_window(fn, n_steps: int, logdir: str, *args, **kwargs) -> str:
     return logdir
 
 
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                continue
+    return total
+
+
 class ProfilerCallback(Callback):
-    """Capture an XLA trace of a window of train steps, log it as an artifact.
+    """Capture XLA traces of train-step windows, with an optional cadence.
 
     Args:
-      logdir: where to write the trace (default: a temp dir, removed after
-        the artifact is logged).
+      logdir: where to write traces.  One-shot mode defaults to a temp
+        dir (removed after the artifact is logged); cadence mode needs a
+        stable home and defaults to ``<tmp>/tpuframe_profile_<pid>``.
       skip_steps: batches to skip first (warmup/compile noise).
-      num_steps: batches to capture.
-    After capture, the trace directory is zipped and handed to every logger
-    exposing a ``run.log_artifact`` (tpuframe's MLflowLogger) or
-    ``log_artifact`` — rank-0 only, matching the logging discipline.
+      num_steps: batches per capture window.
+      every_steps: 0 (default) = one capture then done; N > 0 = start a
+        fresh ``num_steps``-step capture every N batches, each into its
+        own ``capture-b<batch>`` subdir of ``logdir``, oldest dirs
+        dropped past ``keep`` (rotation mirrors the telemetry log's
+        ``TPUFRAME_TELEMETRY_KEEP`` discipline).
+      keep: capture dirs retained in cadence mode (default 3).
+      rank0_only: capture on the main process only (default True — one
+        host's trace prices the fleet; every rank tracing would multiply
+        the overhead and the disk for identical programs).
+
+    One-shot captures are zipped and handed to every logger exposing a
+    ``run.log_artifact`` (tpuframe's MLflowLogger) or ``log_artifact`` —
+    rank-0 only, matching the logging discipline.  Cadence captures stay
+    on disk as parseable evidence instead (artifact-zipping every window
+    of a week-long run would flood the tracker).
     """
 
     def __init__(
@@ -83,34 +130,93 @@ class ProfilerCallback(Callback):
         logdir: str | None = None,
         skip_steps: int = 3,
         num_steps: int = 5,
+        *,
+        every_steps: int = 0,
+        keep: int | None = None,
+        rank0_only: bool = True,
     ):
         self.logdir = logdir
         self.skip_steps = skip_steps
-        self.num_steps = num_steps
+        self.num_steps = max(1, int(num_steps))
+        self.every_steps = max(0, int(every_steps))
+        self.keep = 3 if keep is None else max(1, int(keep))
+        self.rank0_only = rank0_only
         self._tmp: str | None = None
         self._active = False
         self._done = False
+        self._next_start = None  # cadence: earliest batch to start at
+        self._capture_dir: str | None = None
+        self._anchor: tuple[float, float] | None = None  # (wall, mono)
         self.trace_dir: str | None = None
         self.artifact: str | None = None
+        #: completed captures, newest last: {dir, steps, bytes, partial}
+        self.captures: list[dict] = []
         #: True when the fit ended inside the capture window (the logged
         #: trace covers fewer than ``num_steps`` steps)
         self.partial = False
 
-    def _target(self) -> str:
+    @classmethod
+    def from_env(cls) -> "ProfilerCallback | None":
+        """The env-armed instance (``TPUFRAME_PROFILE_STEPS`` > 0 arms
+        it; EVERY/KEEP/DIR refine), or None when capture is off.  The
+        Trainer auto-attaches this so a launch env flag is all a long
+        run needs to carry bounded device-time evidence."""
+        from tpuframe.track.device_time import profile_env
+
+        env = profile_env()
+        steps = env["TPUFRAME_PROFILE_STEPS"]
+        if not steps:
+            return None
+        return cls(
+            logdir=env["TPUFRAME_PROFILE_DIR"] or None,
+            num_steps=steps,
+            every_steps=env["TPUFRAME_PROFILE_EVERY"],
+            keep=env["TPUFRAME_PROFILE_KEEP"],
+        )
+
+    @property
+    def cadence(self) -> bool:
+        return self.every_steps > 0
+
+    def _base_dir(self) -> str:
         if self.logdir is None and self._tmp is None:
-            self._tmp = tempfile.mkdtemp(prefix="tpuframe_trace_")
+            if self.cadence:
+                # cadence evidence must outlive the callback: a stable
+                # per-process home, not a remove-after-artifact temp dir
+                self._tmp = os.path.join(
+                    tempfile.gettempdir(), f"tpuframe_profile_{os.getpid()}"
+                )
+            else:
+                self._tmp = tempfile.mkdtemp(prefix="tpuframe_trace_")
         return self.logdir or self._tmp
 
+    def _target(self) -> str:
+        base = self._base_dir()
+        if self.cadence:
+            return os.path.join(base, f"capture-b{self._start_batch:08d}")
+        return base
+
     def on_step_start(self, trainer: "Trainer") -> None:
-        if self._done or self._active or trainer.batches_seen < self.skip_steps:
+        if self._done or self._active:
+            return
+        if self.rank0_only and not trainer.is_main:
+            self._done = True  # never arms on this rank; stop checking
+            return
+        start_at = (
+            self._next_start if self._next_start is not None
+            else self.skip_steps
+        )
+        if trainer.batches_seen < start_at:
             return
         import jax
 
+        self._start_batch = trainer.batches_seen
         target = self._target()
         os.makedirs(target, exist_ok=True)
+        self._anchor = (time.time(), time.monotonic())
         jax.profiler.start_trace(target)
         self._active = True
-        self._start_batch = trainer.batches_seen
+        self._capture_dir = target
 
     def on_step_end(self, trainer: "Trainer") -> None:
         if not self._active:
@@ -120,38 +226,89 @@ class ProfilerCallback(Callback):
         self._finalize(trainer, partial=False)
 
     def on_fit_end(self, trainer: "Trainer") -> None:
-        # fit ended mid-capture (duration reached / early stop): close the
+        # fit ended mid-capture (duration reached / early stop / a step
+        # that RAISED — on_fit_end fires from fit()'s finally): close the
         # trace so the profiler isn't left running across fits, then KEEP
         # the evidence — a partial window is still a real trace of real
-        # steps, and a fit short enough to end inside the window is
-        # exactly the fit whose trace would otherwise never exist.  Marked
-        # ``partial`` and logged like a full capture (rank-0 discipline);
-        # ``_done`` stays set so a later fit can't mix a fresh session
-        # into the same directory.
+        # steps, and the window containing the raising step is exactly
+        # the trace someone debugging it wants.  Marked ``partial`` and
+        # logged like a full capture (rank-0 discipline).
         if self._active:
             self._finalize(trainer, partial=True)
+            self._done = True  # no fresh session after the fit ended
 
     def _finalize(self, trainer: "Trainer", *, partial: bool) -> None:
         import jax
 
-        jax.block_until_ready(trainer.state)
-        jax.profiler.stop_trace()
-        self._active = False
-        self._done = True
+        try:
+            # include in-flight device work; a poisoned state (the step
+            # raised) must not leave the profiler started
+            jax.block_until_ready(trainer.state)
+        except Exception:
+            pass
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False
         self.partial = partial
-        if trainer.is_main:
-            self._log_artifact(trainer)
-        if self._tmp is not None:
-            # the temp capture dir is deleted below: publish the zipped
-            # artifact (``self.artifact``) instead of a dangling path
-            shutil.rmtree(self._tmp, ignore_errors=True)
-            self._tmp = None
-            self.trace_dir = None
+        steps = max(0, trainer.batches_seen - self._start_batch)
+        cap_dir = self._capture_dir
+        cap = {
+            "dir": cap_dir,
+            "steps": steps,
+            "bytes": _dir_bytes(cap_dir) if cap_dir else 0,
+            "partial": partial,
+        }
+        self.captures.append(cap)
+        self._emit_capture_event(cap)
+        if self.cadence:
+            self.trace_dir = cap_dir
+            self._rotate()
+            # schedule the next window from this one's START, so the
+            # cadence is "every N steps", not "N steps of gap"
+            self._next_start = self._start_batch + max(
+                self.every_steps, self.num_steps
+            )
         else:
-            self.trace_dir = self.logdir
+            self._done = True
+            if trainer.is_main:
+                self._log_artifact(trainer)
+            if self._tmp is not None:
+                # the temp capture dir is deleted below: publish the zipped
+                # artifact (``self.artifact``) instead of a dangling path
+                shutil.rmtree(self._tmp, ignore_errors=True)
+                self._tmp = None
+                self.trace_dir = None
+            else:
+                self.trace_dir = self.logdir
+
+    def _emit_capture_event(self, cap: dict) -> None:
+        from tpuframe.track.telemetry import get_telemetry
+
+        tele = get_telemetry()
+        tele.registry.counter("profile/captures").inc()
+        wall, mono = self._anchor or (None, None)
+        tele.event(
+            "profile/capture",
+            dir=cap["dir"],
+            steps=cap["steps"],
+            bytes=cap["bytes"],
+            partial=cap["partial"],
+            wall_start=wall,
+            mono_start=mono,
+        )
+
+    def _rotate(self) -> None:
+        """Drop capture dirs past ``keep``, oldest first (the batch-
+        numbered names sort chronologically)."""
+        from tpuframe.track.device_time import list_captures
+
+        caps = list_captures(self._base_dir())
+        for stale in caps[: max(0, len(caps) - self.keep)]:
+            shutil.rmtree(stale, ignore_errors=True)
 
     def _log_artifact(self, trainer: "Trainer") -> None:
-        src = self._target()
+        src = self._capture_dir or self._base_dir()
         base = os.path.join(
             tempfile.mkdtemp(prefix="tpuframe_trace_zip_"), "xla_trace"
         )

@@ -237,6 +237,19 @@ class Trainer:
         self.eval_dataloader = eval_dataloader
         self.max_duration = Duration.parse(max_duration)
         self.callbacks = list(callbacks)
+        # env-armed sampled profiler capture: a launch that ships
+        # TPUFRAME_PROFILE_* gets bounded device-time evidence with no
+        # code change; an explicitly-passed ProfilerCallback keeps
+        # authority over its own cadence
+        if os.environ.get("TPUFRAME_PROFILE_STEPS", "").strip():
+            from tpuframe.track.profiler import ProfilerCallback
+
+            if not any(
+                isinstance(cb, ProfilerCallback) for cb in self.callbacks
+            ):
+                env_profiler = ProfilerCallback.from_env()
+                if env_profiler is not None:
+                    self.callbacks.append(env_profiler)
         self.loggers = list(loggers)
         self.loss_fn = loss_fn
         self.seed = seed
