@@ -26,8 +26,28 @@ tpuframe.track analyze --baseline benchmarks/results/`` ratios future
 runs against (``ratio_bytes_on_wire`` / ``ratio_allreduce_p50``,
 exit 3 on regression).
 
+``--overlap`` runs the other A/B this file owns: the SAME compressed
+fit single-shot (one sync after backward) vs bucket-group scheduled
+(``plan.comms_groups`` — the sync fires as N collectives in
+reverse-backward order so group i's wire rides while group i+1's math
+is still executing).  Both arms are AOT-compiled through the compile
+spine (``precompile_call`` + ``ShapeGuard`` — the committed record
+proves zero ``compile/recompile`` / ``compile/aot_fallback`` during the
+fit), profiled with ``jax.profiler`` and parsed by
+``device_time_report``; the headline is **exposed comms** (collective
+wall NOT hidden behind compute) per step and ``overlap_efficiency``,
+plus a bit-exact check of the synced gradients and EF residual across
+arms (grouping must not change a single bit of the wire math; final
+params drift only at the ulp level from XLA refusing the *optimizer*
+arithmetic differently across the two programs).  The grouped
+arm's parsed capture is committed as the record's top-level
+``device_time`` block — the ``ratio_exposed_comms`` baseline the
+analyzer gates future runs against.
+
 Usage: python benchmarks/bench_collectives.py [--payload-mb 8]
            [--iters 30] [--steps 30] [--json-only]
+       python benchmarks/bench_collectives.py --overlap
+           [--overlap-groups 4] [--overlap-steps 12] [--overlap-width 768]
 """
 
 from __future__ import annotations
@@ -85,6 +105,273 @@ def time_steps(step, state, batches) -> list[float]:
     return walls
 
 
+def run_overlap(args) -> int:
+    """The grouped-schedule A/B: single-shot sync vs bucket-group
+    scheduled sync, same model, same batches, same seeds — exposed
+    comms measured off a parsed profiler capture per arm, final params
+    compared bit-for-bit."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from tpuframe.compile.precompile import (
+        ShapeGuard,
+        abstract_state,
+        batch_signature,
+        precompile_call,
+    )
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.parallel import ParallelPlan
+    from tpuframe.parallel.compression import (
+        CommsConfig,
+        comms_template,
+        grad_layout,
+        init_comms_state,
+        make_compressed_pmean,
+        wire_plan,
+    )
+    from tpuframe.track.device_time import device_time_report
+    from tpuframe.track.profiler import trace
+    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.train import (
+        create_train_state,
+        make_grad_accum_step,
+        make_train_step,
+    )
+
+    world = len(jax.devices())
+    mesh = MeshSpec(data=world).build()
+    width = int(args.overlap_width)
+    n_steps = int(args.overlap_steps)
+    accum = max(1, int(args.overlap_accum))
+    warmup = 3
+
+    class Net(nn.Module):
+        """Deep enough that backward has real math for the wire to hide
+        behind; wide enough that the gradient tree spans many buckets."""
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            for _ in range(4):
+                x = nn.relu(nn.Dense(width)(x))
+            return nn.Dense(16)(x)
+
+    config = CommsConfig(
+        mode="int8", bucket_mb=args.bucket_mb, error_feedback=True
+    )
+
+    per_dev = int(args.overlap_batch)
+
+    def mk_batches(plan, n):
+        # grad-accum batches lead with the microbatch dim: the overlap
+        # story IS the accum path (the peeled last microbatch's backward
+        # is the compute the per-group collectives spread into)
+        r = np.random.default_rng(7)
+        out = []
+        for _ in range(n):
+            shape = (accum, per_dev * world) if accum > 1 else (per_dev * world,)
+            img = r.standard_normal(shape + (16, 16, 1)).astype(np.float32)
+            lab = r.integers(0, 16, shape).astype(np.int32)
+            out.append(plan.shard_batch(
+                {"image": img, "label": lab}, leading_microbatch=accum > 1,
+            ))
+        return out
+
+    tele = get_telemetry()
+    plan_single = ParallelPlan(mesh=mesh)
+    plan_grouped = ParallelPlan(
+        mesh=mesh, comms_groups=max(2, int(args.overlap_groups))
+    )
+
+    def mk_state(plan):
+        s = create_train_state(
+            Net(), jax.random.PRNGKey(0),
+            jnp.ones((1, 16, 16, 1), jnp.float32), optax.adamw(1e-3),
+            plan=plan,
+        )
+        return s.replace(comms=init_comms_state(s.params, plan, config))
+
+    def bits_equal(a, b) -> bool:
+        la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+        return len(la) == len(lb) and all(
+            np.asarray(x).tobytes() == np.asarray(y).tobytes()
+            for x, y in zip(la, lb)
+        )
+
+    # the bit-exactness contract is on the SYNC: same params, same
+    # grads, same residual -> the grouped schedule must produce the
+    # identical mean gradient and EF residual, bit for bit.  (Full-fit
+    # params drift at the ulp level because XLA fuses the *optimizer*
+    # math differently across the two programs — FMA reassociation, not
+    # schedule semantics; reported as a max-abs diff for honesty.)
+    # Runs BEFORE the fits: the train step donates its state, so the
+    # init params wouldn't survive an arm.
+    s0 = mk_state(plan_single)
+
+    def loss(params, img, lab):
+        logits = s0.apply_fn({"params": params}, img)
+        oh = jax.nn.one_hot(lab, 16)
+        return -jnp.mean(jnp.sum(oh * jax.nn.log_softmax(logits), -1))
+
+    rr = np.random.default_rng(7)
+    img = jnp.asarray(rr.standard_normal((16, 16, 16, 1)), jnp.float32)
+    lab = jnp.asarray(rr.integers(0, 16, 16), jnp.int32)
+    grads = jax.grad(loss)(s0.params, img, lab)
+    resid = {
+        k: jnp.zeros(v, jnp.float32)
+        for k, v in comms_template(s0.params, config, plan_single).items()
+    }
+    o1, r1 = make_compressed_pmean(plan_single, config)(grads, resid)
+    og, rg = make_compressed_pmean(plan_grouped, config)(grads, resid)
+    bit_exact = bits_equal(o1, og)
+    bit_exact_resid = bits_equal(r1, rg)
+    del s0, grads, resid, o1, r1, og, rg
+
+    def run_arm(plan) -> dict:
+        groups = plan.comms_groups or 1
+        if accum > 1:
+            step = make_grad_accum_step(
+                accum, plan=plan, grad_compression=config
+            )
+        else:
+            step = make_train_step(plan=plan, grad_compression=config)
+        state = mk_state(plan)
+        batches = mk_batches(plan, warmup + n_steps)
+        recompiles0 = tele.registry.counter("compile/recompiles").value
+        compiled = precompile_call(
+            step, (abstract_state(state), batches[0]),
+            label=f"bench/overlap@groups{groups}",
+        )
+        # the Trainer's dispatch contract in miniature: armed guard +
+        # AOT executable, jit fallback only on a loud event — the
+        # committed zero counts are the no-recompile proof
+        guard = ShapeGuard(tele)
+        guard.expect("train", batch_signature(batches[0]))
+        fallbacks = 0
+
+        def dispatch(state, batch):
+            nonlocal fallbacks
+            guard.check("train", batch_signature(batch))
+            if compiled is not None:
+                try:
+                    return compiled(state, batch)
+                except Exception as e:
+                    fallbacks += 1
+                    tele.event(
+                        "compile/aot_fallback", step_kind="train",
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
+            return step(state, batch)
+
+        for b in batches[:warmup]:
+            state, metrics = dispatch(state, b)
+            jax.block_until_ready(metrics)
+        walls = []
+        logdir = tempfile.mkdtemp(prefix=f"tpuframe_overlap_g{groups}_")
+        with trace(logdir):
+            for b in batches[warmup:]:
+                t0 = time.perf_counter()
+                state, metrics = dispatch(state, b)
+                jax.block_until_ready(metrics)
+                walls.append(time.perf_counter() - t0)
+            jax.block_until_ready(state)
+        dt = device_time_report(logdir, steps=n_steps) or {}
+        dt["trace_dir"] = None  # temp dir: gone by the time anyone reads this
+        shutil_rmtree(logdir)
+        wire = getattr(step, "wire", None) or wire_plan(
+            grad_layout(state.params, config, plan), config
+        )
+        return {
+            "groups": groups,
+            "state": state,
+            "wire": wire,
+            "device_time": dt,
+            "step_p50_s": round(statistics.median(sorted(walls)), 6),
+            "recompile_events": int(
+                tele.registry.counter("compile/recompiles").value
+                - recompiles0
+            ),
+            "aot_fallback_events": fallbacks,
+            "aot_dispatch": compiled is not None,
+        }
+
+    single = run_arm(plan_single)
+    grouped = run_arm(plan_grouped)
+    params_drift = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree.leaves(single["state"].params),
+            jax.tree.leaves(grouped["state"].params),
+        )
+    )
+
+    def arm_rec(arm: dict) -> dict:
+        dt = arm["device_time"]
+        return {
+            "groups": arm["groups"],
+            "step_p50_s": arm["step_p50_s"],
+            "exposed_comms_per_step_s": dt.get("exposed_comms_per_step_s"),
+            "overlap_efficiency": dt.get("overlap_efficiency"),
+            "collective_wall_s": (
+                (dt.get("classes") or {}).get("collective") or {}
+            ).get("wall_s"),
+            "recompile_events": arm["recompile_events"],
+            "aot_fallback_events": arm["aot_fallback_events"],
+            "aot_dispatch": arm["aot_dispatch"],
+        }
+
+    se = single["device_time"].get("exposed_comms_per_step_s") or 0.0
+    ge = grouped["device_time"].get("exposed_comms_per_step_s") or 0.0
+    rec = {
+        "benchmark": "collectives_overlap",
+        "backend": jax.default_backend(),
+        "world": world,
+        "mode": "int8_ef",
+        "model_params_mb": round(
+            sum(int(x.size) for x in jax.tree.leaves(single["state"].params))
+            * 4 / (1 << 20), 3,
+        ),
+        "steps_per_arm": n_steps,
+        "overlap": {
+            "single": arm_rec(single),
+            "grouped": arm_rec(grouped),
+            "bit_exact_synced_grads": bit_exact,
+            "bit_exact_ef_residual": bit_exact_resid,
+            "final_params_max_abs_diff": params_drift,
+            "exposed_reduction_x": (
+                round(se / ge, 3) if se and ge else None
+            ),
+        },
+        "wire": {
+            k: grouped["wire"].get(k)
+            for k in ("mode", "world", "n_buckets", "bucket_elems",
+                      "bytes_per_step", "overlap_groups", "groups")
+        },
+        # the analyzer's ratio_exposed_comms baseline anchor — the
+        # grouped arm IS the configuration this record recommends
+        "device_time": grouped["device_time"],
+    }
+    print(json.dumps(rec, indent=1))
+    ok = (
+        bit_exact
+        and bit_exact_resid
+        and grouped["recompile_events"] == 0
+        and grouped["aot_fallback_events"] == 0
+    )
+    return 0 if ok else 4
+
+
+def shutil_rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--payload-mb", type=float, default=8.0)
@@ -92,6 +379,15 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=30,
                     help="matched A/B train steps per arm")
     ap.add_argument("--bucket-mb", type=float, default=4.0)
+    ap.add_argument("--overlap", action="store_true",
+                    help="run the bucket-group overlap A/B instead")
+    ap.add_argument("--overlap-groups", type=int, default=4)
+    ap.add_argument("--overlap-steps", type=int, default=12)
+    ap.add_argument("--overlap-width", type=int, default=768)
+    ap.add_argument("--overlap-batch", type=int, default=8,
+                    help="per-device samples per microbatch per overlap step")
+    ap.add_argument("--overlap-accum", type=int, default=4,
+                    help="microbatches per overlap step (1 = plain step)")
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
@@ -101,6 +397,9 @@ def main() -> int:
         from tpuframe.core.runtime import simulate_cpu_devices
 
         simulate_cpu_devices(8)
+
+    if args.overlap:
+        return run_overlap(args)
 
     import jax
     import jax.numpy as jnp

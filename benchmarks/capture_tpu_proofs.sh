@@ -215,6 +215,17 @@ run bench_serve_fleet.json     300  python benchmarks/bench_serve.py --fleet
 # starts buying DCN
 run bench_collectives.json    300  python benchmarks/bench_collectives.py
 
+# overlap rung: bucket-group scheduled sync vs single shot through the
+# REAL overlapped train step (AOT-dispatched, traced) — grouped must be
+# bit-exact on synced grads + EF residual and show exposed comms at or
+# below single-shot; the committed `device_time` block is what
+# `track analyze --baseline` gates ratio_exposed_comms against (exit 3).
+# TPUFRAME_COMMS_ASYNC=1 resolves the latency-hiding XLA flags the same
+# way a production fit would (restart-only knob, so it rides the env)
+TPUFRAME_COMMS_ASYNC=1 \
+run bench_overlap.json        600  python benchmarks/bench_collectives.py \
+  --overlap --overlap-width 1536 --bucket-mb 2.0
+
 # compile-spine rung: cold vs warm-cache vs AOT-overlapped
 # time-to-first-step on the real chip — the committed
 # time_to_first_step block is what `track analyze --baseline` gates

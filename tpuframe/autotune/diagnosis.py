@@ -187,6 +187,16 @@ def diagnose(report: dict, *, gauges: dict | None = None) -> Diagnosis:
         if (comms.get("mode") or "none") in ("none", ""):
             move("TPUFRAME_COMMS_COMPRESSION", "int8",
                  "comms-bound at f32 wire: int8 is ~4x fewer sync bytes")
+        if exposed:
+            # the overlap probe: gated on the MEASURED exposed wall (a
+            # parsed capture), because group scheduling only pays when
+            # collective seconds are provably NOT hidden behind compute
+            # — bytes-on-wire is invariant under grouping, so the probe
+            # must judge itself on exposed ms/step, nothing else
+            move("TPUFRAME_COMMS_GROUPS", 4,
+                 f"comms-bound: {exposed * 1e3:.2f}ms/step exposed — "
+                 "fire the sync as 4 bucket groups in reverse-backward "
+                 "order so the wire hides behind the remaining backward")
         move("TPUFRAME_COMMS_BUCKET_MB", 8.0, why_bucket)
         move("TPUFRAME_GRAD_ACCUM", 2,
              "comms-bound: accumulate micro-batches, sync once per "

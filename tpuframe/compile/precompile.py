@@ -181,6 +181,14 @@ def precompile_call(fn, abstract_args: tuple, *, label: str):
     (i.e. ``fn`` IS the jitted function — wrappers do per-call host work
     the executable wouldn't), else None; in both cases the compile has
     happened and the persistent cache is warm.
+
+    A compressed step's wire plan (``fn.wire``) rides the
+    ``compile/backend_compile`` span as ``comms_groups`` when it
+    declares a bucket-group schedule: the lowered program *bakes in* one
+    collective per group, so the AOT record must name the schedule it
+    compiled — an overlapped fit that later recompiles at a different
+    group count is a plan-signature bug, and the span attribution is
+    what makes that diffable.
     """
     target = getattr(fn, "_inner_jit", fn)
     if not hasattr(target, "lower"):
@@ -188,7 +196,13 @@ def precompile_call(fn, abstract_args: tuple, *, label: str):
     tele = get_telemetry()
     with tele.span("compile/lower", label=label):
         lowered = target.lower(*abstract_args)
-    with tele.span("compile/backend_compile", label=label), \
+    # the wire plan materializes during lower (deferred-built steps set
+    # it on first build), so the schedule is read *after* lowering
+    extra = {}
+    groups = (getattr(fn, "wire", None) or {}).get("overlap_groups")
+    if groups and groups > 1:
+        extra["comms_groups"] = int(groups)
+    with tele.span("compile/backend_compile", label=label, **extra), \
             compile_label(label, span=True):
         compiled = lowered.compile()
     return compiled if target is fn else None

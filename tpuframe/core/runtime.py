@@ -221,6 +221,15 @@ def initialize(
     """
     global _CURRENT
 
+    # TPUFRAME_COMMS_ASYNC: merge the latency-hiding-scheduler /
+    # async-collective-fusion flags into XLA_FLAGS FIRST — XLA reads
+    # them at backend init, and everything below (distributed init,
+    # mesh build) can trigger that.  The resolver is platform-gated
+    # without importing a backend (asking jax would initialize it), and
+    # returns the empty set on CPU where the flags would abort the
+    # compiler; restart-only semantics, like every comms knob.
+    _apply_comms_async_flags()
+
     if debug is None:
         debug = os.environ.get("TPUFRAME_DEBUG", "").strip().lower() not in (
             "", "0", "false", "no", "off",
@@ -352,6 +361,21 @@ def is_main_process() -> bool:
     ``global_rank == 0`` before every MLflow/checkpoint call, e.g.
     `/root/reference/01_torch_distributor/01_basic_torch_distributor.py:236-237`)."""
     return jax.process_index() == 0
+
+
+def _apply_comms_async_flags() -> None:
+    """Merge the ``TPUFRAME_COMMS_ASYNC`` flag set into ``XLA_FLAGS``
+    (idempotent: flags already present are not duplicated).  No-op when
+    the knob is off or the platform resolves no flags."""
+    from tpuframe.parallel.comms_env import comms_async_flags
+
+    wanted = comms_async_flags()
+    if not wanted:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    missing = [f for f in wanted if f.split("=")[0] not in flags]
+    if missing:
+        os.environ["XLA_FLAGS"] = " ".join([flags] + missing).strip()
 
 
 def simulate_cpu_devices(n: int = 8) -> None:

@@ -347,13 +347,28 @@ def comms_section() -> dict:
     serve/ckpt sections."""
     import dataclasses
 
-    from tpuframe.parallel.comms_env import COMMS_ENV_VARS, CommsConfig
+    from tpuframe.parallel.comms_env import (
+        COMMS_ENV_VARS,
+        CommsConfig,
+        comms_async_enabled,
+        comms_async_flags,
+        comms_async_platform,
+    )
 
     out: dict = {
         "env": {
             k: os.environ[k] for k in COMMS_ENV_VARS if k in os.environ
         },
         "bench": "python benchmarks/bench_collectives.py",
+    }
+    # the async-scheduler knob resolves per-platform (restart-only):
+    # print exactly the XLA flag set initialize() would merge, so "why
+    # is my overlap not overlapping" is answerable from the report
+    plat = comms_async_platform()
+    out["async"] = {
+        "enabled": comms_async_enabled(),
+        "platform": plat,
+        "flags": list(comms_async_flags(plat)),
     }
     try:
         config = CommsConfig.from_env()

@@ -21,6 +21,24 @@ Knob semantics (the one table, mirrored in OBSERVABILITY.md):
 - ``TPUFRAME_COMMS_EF`` — error feedback on/off (default on): the
   quantization residual is carried as a ``TrainState.comms`` leaf and
   re-injected next step, so the compressed trajectory tracks f32.
+- ``TPUFRAME_COMMS_GROUPS`` — bucket-group count for the scheduled
+  sync (default 1 = the single-shot collective).  Groups fire in
+  reverse path-sorted order (the reverse-backward leaf order: the
+  deepest layers' gradients are produced first), so group *i*'s
+  quantized collective is dataflow-independent of group *i+1*'s
+  quantization and can hide behind it.  Bit-exact against the
+  single-shot reference — per-bucket scales/EF/non-finite handling are
+  elementwise over the bucket dimension, so partitioning changes the
+  schedule, never the arithmetic.  A ``ParallelPlan.comms_groups``
+  override wins over the env (the plan is the first-class schedule
+  artifact).
+- ``TPUFRAME_COMMS_ASYNC`` — ``1`` turns on the backend's
+  latency-hiding-scheduler / async-collective-fusion XLA flags at
+  ``core.runtime.initialize`` (:func:`comms_async_flags` is the one
+  resolver; the doctor prints the resolved set).  Restart-only: XLA
+  reads the flags at backend init.  No-op on CPU — the CPU compiler
+  rejects the TPU/GPU scheduler flags, so the resolver returns an
+  empty set there rather than aborting the process.
 """
 
 # tpuframe-lint: stdlib-only
@@ -30,7 +48,14 @@ from __future__ import annotations
 import dataclasses
 import os
 
-__all__ = ["COMMS_ENV_VARS", "CommsConfig", "COMPRESSION_MODES"]
+__all__ = [
+    "COMMS_ENV_VARS",
+    "CommsConfig",
+    "COMPRESSION_MODES",
+    "comms_async_enabled",
+    "comms_async_flags",
+    "comms_async_platform",
+]
 
 #: the comms spine's env knobs — aggregated by
 #: ``launch.remote.all_env_vars()`` and printed by the doctor
@@ -39,6 +64,8 @@ COMMS_ENV_VARS = (
     "TPUFRAME_COMMS_BUCKET_MB",
     "TPUFRAME_COMMS_STOCHASTIC",
     "TPUFRAME_COMMS_EF",
+    "TPUFRAME_COMMS_GROUPS",
+    "TPUFRAME_COMMS_ASYNC",
 )
 
 #: value domains for the knobs above (KN007).  All "restart":
@@ -51,6 +78,9 @@ COMMS_ENV_DOMAINS = {
         "type": "float", "range": (0.25, 1024.0), "apply": "restart"},
     "TPUFRAME_COMMS_STOCHASTIC": {"type": "bool", "apply": "restart"},
     "TPUFRAME_COMMS_EF": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_COMMS_GROUPS": {
+        "type": "int", "range": (1, 64), "apply": "restart"},
+    "TPUFRAME_COMMS_ASYNC": {"type": "bool", "apply": "restart"},
 }
 
 #: wire formats the compressed collectives understand
@@ -76,6 +106,77 @@ def _env_bool(name: str, default: bool) -> bool:
     return raw.strip().lower() not in _FALSY
 
 
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+# -- TPUFRAME_COMMS_ASYNC: the XLA scheduler flag resolver --------------------
+
+#: per-platform flag sets the async knob turns on.  TPU: the
+#: latency-hiding scheduler (orders independent collectives into
+#: compute gaps) + async-collective fusion (keeps the DMA in flight
+#: across the fused region).  GPU: the LHS has its own flag name.
+#: CPU has neither pass and the compiler aborts on unknown flags, so
+#: its entry is the empty set — the knob degrades to a no-op there.
+_ASYNC_FLAGS = {
+    "tpu": (
+        "--xla_tpu_enable_latency_hiding_scheduler=true",
+        "--xla_tpu_enable_async_collective_fusion=true",
+    ),
+    "gpu": ("--xla_gpu_enable_latency_hiding_scheduler=true",),
+    "cuda": ("--xla_gpu_enable_latency_hiding_scheduler=true",),
+}
+
+
+def comms_async_enabled(environ: dict | None = None) -> bool:
+    """Is ``TPUFRAME_COMMS_ASYNC`` requested? (Whether it resolves to
+    any flags is the platform's call — :func:`comms_async_flags`.)"""
+    env = os.environ if environ is None else environ
+    raw = env.get("TPUFRAME_COMMS_ASYNC")
+    if raw is None:
+        return False
+    return raw.strip().lower() not in _FALSY
+
+
+def comms_async_platform(environ: dict | None = None) -> str:
+    """Best-effort backend guess WITHOUT importing jax (asking jax for
+    its backend would initialize it — exactly what must not happen
+    before the flags are merged into ``XLA_FLAGS``): the first
+    ``JAX_PLATFORMS`` token when set, else "tpu" when libtpu is
+    importable, else "cpu"."""
+    env = os.environ if environ is None else environ
+    plats = env.get("JAX_PLATFORMS", "").strip().lower()
+    if plats:
+        return plats.split(",")[0].strip() or "cpu"
+    try:
+        import importlib.util
+
+        if importlib.util.find_spec("libtpu") is not None:
+            return "tpu"
+    except (ImportError, ValueError):
+        pass
+    return "cpu"
+
+
+def comms_async_flags(platform: str | None = None,
+                      environ: dict | None = None) -> tuple[str, ...]:
+    """The resolved XLA flag set ``TPUFRAME_COMMS_ASYNC`` adds for
+    ``platform`` (default: :func:`comms_async_platform`), or ``()``
+    when the knob is off or the platform has no safe flags.  One
+    resolver for ``core.runtime.initialize`` (applies it) and the
+    doctor (prints it)."""
+    if not comms_async_enabled(environ):
+        return ()
+    plat = platform if platform is not None else comms_async_platform(environ)
+    return _ASYNC_FLAGS.get(plat, ())
+
+
 @dataclasses.dataclass(frozen=True)
 class CommsConfig:
     """Resolved wire-compression policy for the gradient collectives.
@@ -88,6 +189,9 @@ class CommsConfig:
     bucket_mb: float = 4.0
     stochastic_rounding: bool = False
     error_feedback: bool = True
+    #: bucket-group count for the scheduled sync (1 = single shot).
+    #: More groups than buckets clamps down at layout build.
+    groups: int = 1
 
     def __post_init__(self):
         if self.mode not in COMPRESSION_MODES:
@@ -97,6 +201,8 @@ class CommsConfig:
             )
         if self.bucket_mb <= 0:
             raise ValueError(f"bucket_mb must be > 0, got {self.bucket_mb}")
+        if self.groups < 1:
+            raise ValueError(f"groups must be >= 1, got {self.groups}")
 
     @property
     def bucket_elems(self) -> int:
@@ -129,4 +235,5 @@ class CommsConfig:
             bucket_mb=_env_float("TPUFRAME_COMMS_BUCKET_MB", 4.0),
             stochastic_rounding=_env_bool("TPUFRAME_COMMS_STOCHASTIC", False),
             error_feedback=_env_bool("TPUFRAME_COMMS_EF", True),
+            groups=max(1, _env_int("TPUFRAME_COMMS_GROUPS", 1)),
         )

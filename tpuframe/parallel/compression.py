@@ -148,10 +148,21 @@ class GradLayout:
     bucket_elems: int
     axes: tuple
     world: int
+    #: [(start_bucket, stop_bucket)] in FIRE order — the bucket-group
+    #: schedule.  Reverse path-sorted: path order approximates forward
+    #: model order, backward produces the deepest (highest-offset)
+    #: leaves first, so the group covering the top bucket range fires
+    #: first and its collective hides behind the rest of the backward.
+    #: Empty = single shot (equivalent to one group over everything).
+    group_bounds: tuple = ()
 
     @property
     def padded_elems(self) -> int:
         return self.n_buckets * self.bucket_elems
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.group_bounds) or 1
 
 
 def _bucket_layout(total: int, config: CommsConfig) -> tuple[int, int]:
@@ -166,12 +177,35 @@ def _bucket_layout(total: int, config: CommsConfig) -> tuple[int, int]:
     return n, be
 
 
-def grad_layout(tree: Any, config: CommsConfig, plan: Any = None) -> GradLayout:
+def _group_bounds(n_buckets: int, groups: int) -> tuple:
+    """Partition ``n_buckets`` into ``groups`` contiguous near-equal
+    ranges, returned in FIRE order (reverse bucket order — the
+    reverse-backward leaf order).  Clamped: more groups than buckets
+    degenerates to one bucket per group."""
+    g = max(1, min(int(groups), n_buckets)) if n_buckets else 0
+    if not g:
+        return ()
+    base, rem = divmod(n_buckets, g)
+    bounds, start = [], 0
+    for i in range(g):
+        stop = start + base + (1 if i < rem else 0)
+        bounds.append((start, stop))
+        start = stop
+    return tuple(reversed(bounds))
+
+
+def grad_layout(tree: Any, config: CommsConfig, plan: Any = None,
+                group_buckets: int | None = None) -> GradLayout:
     """Derive the wire layout for ``tree`` (arrays or ShapeDtypeStructs)
     under ``plan``: ZeRO stage >= 1 routes every leaf the plan's
     ``update_shard_specs`` shards through the compressed reduce-scatter
     -> sharded-update -> all-gather pipeline; everything else through
-    the shared buckets."""
+    the shared buckets.
+
+    ``group_buckets`` partitions the buckets into that many scheduled
+    groups (``GradLayout.group_bounds``, fire order = reverse-backward).
+    Default None resolves the plan's pinned ``comms_groups`` first,
+    then ``config.groups`` (the ``TPUFRAME_COMMS_GROUPS`` env knob)."""
     mesh = getattr(plan, "mesh", None)
     if mesh is not None:
         axes = tuple(
@@ -205,6 +239,10 @@ def grad_layout(tree: Any, config: CommsConfig, plan: Any = None) -> GradLayout:
             flat.append((path, shape, str(dtype), offset))
             offset += int(np.prod(shape)) if shape else 1
     n, be = _bucket_layout(offset, config)
+    if group_buckets is None:
+        group_buckets = getattr(plan, "comms_groups", None)
+    if group_buckets is None:
+        group_buckets = getattr(config, "groups", 1) or 1
     return GradLayout(
         flat=tuple(flat),
         sliced=tuple(sliced),
@@ -214,6 +252,7 @@ def grad_layout(tree: Any, config: CommsConfig, plan: Any = None) -> GradLayout:
         bucket_elems=be,
         axes=axes,
         world=world,
+        group_bounds=_group_bounds(n, group_buckets),
     )
 
 
@@ -268,7 +307,7 @@ def _agreed_amax(amax, axes):
     return jax.lax.pmax(amax, axes) if axes else amax
 
 
-def _encode(v, amax, config: CommsConfig, rng):
+def _encode(v, amax, config: CommsConfig, rng, noise=None):
     """Quantize ``v`` against ``amax`` (broadcast-ready): returns
     ``(payload, deq)`` where ``payload`` is what crosses the wire
     (int32-held int8 values, or f32-held fp8 values — one byte/elem in
@@ -278,14 +317,21 @@ def _encode(v, amax, config: CommsConfig, rng):
     int8: symmetric grid, optional unbiased stochastic rounding
     (``floor(x + u)``); fp8-e4m3: amax mapped onto the 448 grid,
     round-to-nearest-even via the dtype cast (the stochastic knob does
-    not apply), summation upcast."""
+    not apply), summation upcast.
+
+    ``noise`` (optional, ``v``-shaped uniforms) overrides the internal
+    draw — the grouped sync draws ONCE over the full bucket array and
+    slices per group, so the grouped schedule stays bit-exact against
+    the single-shot reference under stochastic rounding."""
     denom = jnp.maximum(amax, jnp.finfo(jnp.float32).tiny)
     if config.mode == "fp8":
         q = ((v / denom) * _FP8_MAX).astype(jnp.float8_e4m3fn)
         return q.astype(jnp.float32), denom / _FP8_MAX
     scale = denom / _QMAX
     x = v / scale
-    if rng is not None and config.stochastic_rounding:
+    if config.stochastic_rounding and noise is not None:
+        x = jnp.floor(x + noise)
+    elif rng is not None and config.stochastic_rounding:
         x = jnp.floor(x + jax.random.uniform(rng, v.shape))
     else:
         x = jnp.round(x)
@@ -304,6 +350,11 @@ def sync_gradients(
     rng=None,
 ):
     """Inside shard_map: compress + reduce this shard's gradient.
+
+    The wire fires as ``layout.group_bounds`` prescribes: one collective
+    per bucket group, emitted in reverse-backward order, each group's
+    psum dataflow-independent of the later groups' quantization — the
+    schedulable form of the single-shot sync, bit-exact against it.
 
     Returns ``(synced, new_comms)`` where ``synced`` matches the
     ``grads`` structure — full mean gradients for bucketed/exact leaves,
@@ -331,7 +382,15 @@ def sync_gradients(
     def subrng(tag: int):
         return None if rng is None else jax.random.fold_in(rng, tag)
 
-    # ---- shared fixed-size buckets (per-bucket scales) ----
+    # ---- shared fixed-size buckets (per-bucket scales), fired as the
+    # layout's bucket-group schedule: one psum per group, emitted in
+    # reverse-backward order so group i's collective is dataflow-
+    # independent of group i+1's quantization (XLA can put it in flight
+    # while the later groups' gradients/encodes are still producing).
+    # Every per-bucket quantity — pmax'd amax, quantize, psum,
+    # non-finite propagation, EF residual — is elementwise over the
+    # bucket dimension, so the partition changes the schedule, never
+    # the arithmetic: grouped output is bit-exact vs the single shot.
     if layout.flat_elems:
         parts = [
             jnp.ravel(leaves[path].astype(jnp.float32))
@@ -344,16 +403,67 @@ def sync_gradients(
         v = flat.reshape(layout.n_buckets, layout.bucket_elems)
         if ef:
             v = v + comms["flat"][0]
-        amax = _agreed_amax(jnp.max(jnp.abs(v), axis=1, keepdims=True), axes)
-        q, deq = _encode(v, amax, config, subrng(0))
-        total = jax.lax.psum(q, axes)
-        mean = total.astype(jnp.float32) * deq / world
-        # per-bucket non-finite propagation (matches exact psum semantics)
-        finite = jnp.isfinite(amax)
-        mean = jnp.where(finite, mean, jnp.nan)
+        # ONE full-shape noise draw, sliced per group: the same uniforms
+        # the single-shot _encode would draw from the same key
+        noise = None
+        if (rng is not None and config.stochastic_rounding
+                and config.mode != "fp8"):
+            noise = jax.random.uniform(subrng(0), v.shape)
+        bounds = layout.group_bounds or ((0, layout.n_buckets),)
+        # software-pipelined emission, group chains still independent:
+        # each group's ops consume only its own bucket slice, so the
+        # dataflow — and therefore what a latency-hiding scheduler may
+        # put in flight while later groups' gradients are still
+        # producing — is identical to a chain-at-a-time emission.  The
+        # EMISSION order is tuned for backends that execute roughly in
+        # program order (XLA:CPU): scales and encodes are staged up
+        # front, the psums are emitted near-adjacently so the wire ops
+        # pipeline against each other, and each group's off-wire math
+        # (EF residual, which never depends on the psum, and the
+        # PREVIOUS group's dequant) is slotted between psum launches so
+        # every rendezvous window has compute to hide behind.
+        amax_g: dict[tuple, Any] = {}
+        enc_g: dict[tuple, Any] = {}
+        for s, e in bounds:  # fire order: reverse-backward
+            amax_g[(s, e)] = _agreed_amax(
+                jnp.max(jnp.abs(v[s:e]), axis=1, keepdims=True), axes
+            )
+        for s, e in bounds:
+            enc_g[(s, e)] = _encode(
+                v[s:e], amax_g[(s, e)], config, None,
+                noise=None if noise is None else noise[s:e],
+            )
+        total_g: dict[tuple, Any] = {}
+        mean_seg: dict[tuple, Any] = {}
+        resid_seg: dict[tuple, Any] = {}
+
+        def _finish(se):
+            _q, deq = enc_g[se]
+            mean_g = total_g[se].astype(jnp.float32) * deq / world
+            # per-bucket non-finite propagation (matches exact psum)
+            mean_seg[se] = jnp.where(jnp.isfinite(amax_g[se]), mean_g, jnp.nan)
+
+        for i, (s, e) in enumerate(bounds):
+            q, deq = enc_g[(s, e)]
+            total_g[(s, e)] = jax.lax.psum(q, axes)
+            if ef:
+                resid = v[s:e] - q.astype(jnp.float32) * deq
+                resid_seg[(s, e)] = jnp.where(
+                    jnp.isfinite(amax_g[(s, e)]), resid, 0.0
+                )
+            if i:
+                _finish(bounds[i - 1])
+        _finish(bounds[-1])
+        order = sorted(bounds)  # reassemble in canonical bucket order
+        mean = (
+            jnp.concatenate([mean_seg[b] for b in order])
+            if len(order) > 1 else mean_seg[order[0]]
+        )
         if ef:
-            resid = v - q.astype(jnp.float32) * deq
-            new_comms["flat"] = jnp.where(finite, resid, 0.0)[None]
+            new_comms["flat"] = (
+                jnp.concatenate([resid_seg[b] for b in order])
+                if len(order) > 1 else resid_seg[order[0]]
+            )[None]
         mean = jnp.ravel(mean)
         for path, shape, dtype, offset in layout.flat:
             size = int(np.prod(shape)) if shape else 1
@@ -364,7 +474,14 @@ def sync_gradients(
         idx = jnp.int32(0)
         for ax in axes:
             idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
-        for tag, (path, shape, dtype, dim) in enumerate(layout.sliced):
+        # under a grouped schedule the per-leaf reduce-scatters emit in
+        # reverse path order too (deepest leaves' grads exist first);
+        # tag keeps the NATURAL index so the stochastic-rounding streams
+        # are bit-identical to the single-shot emission order
+        sliced_items = list(enumerate(layout.sliced))
+        if layout.group_bounds[1:]:  # grouped schedule (static tuple)
+            sliced_items.reverse()
+        for tag, (path, shape, dtype, dim) in sliced_items:
             g = leaves[path].astype(jnp.float32)
             if ef:
                 g = g + comms[_leaf_key(path)][0]
@@ -432,15 +549,30 @@ def wire_plan(layout: GradLayout, config: CommsConfig,
             "bucket_elems": layout.bucket_elems,
             "flat_elems": layout.flat_elems,
             "sliced_leaves": len(layout.sliced),
+            "overlap_groups": layout.n_groups,
+            "groups": [],
         }
     ar = 2.0 * (W - 1) / W   # all-reduce legs
     rs = 1.0 * (W - 1) / W   # reduce-scatter / all-gather leg
     bpe = config.wire_bytes_per_elem
     comp = 0.0
     f32 = 0.0
+    # per-group breakdown (fire order).  Scales stay per-BUCKET under
+    # grouping, so group payload+scale bytes sum to exactly the
+    # single-shot flat contribution — the total below is computed from
+    # the same layout-level quantities grouping cannot change, which is
+    # what keeps comms/bytes_on_wire metering exact under any schedule.
+    groups = []
     if layout.flat_elems:
         comp += ar * (layout.padded_elems * bpe + layout.n_buckets * 4)
         f32 += ar * layout.flat_elems * 4
+        for s, e in (layout.group_bounds or ((0, layout.n_buckets),)):
+            nb = e - s
+            groups.append({
+                "buckets": nb,
+                "payload_bytes": int(round(ar * nb * layout.bucket_elems * bpe)),
+                "scale_bytes": int(round(ar * nb * 4)),
+            })
     for _, shape, _, _ in layout.sliced:
         size = int(np.prod(shape))
         # compressed RS of quantized grads + per-chunk scales, then f32
@@ -459,6 +591,8 @@ def wire_plan(layout: GradLayout, config: CommsConfig,
         "bucket_elems": layout.bucket_elems,
         "flat_elems": layout.flat_elems,
         "sliced_leaves": len(layout.sliced),
+        "overlap_groups": layout.n_groups,
+        "groups": groups,
     }
 
 
@@ -495,6 +629,7 @@ def make_compressed_pmean(plan, config: CommsConfig | str = "int8"):
             layout.flat,
             layout.sliced,
             layout.exact,
+            layout.group_bounds,
             bool(residual),
         )
         if key not in cache:

@@ -145,10 +145,20 @@ class ParallelPlan:
     #: its committed JSON in ``benchmarks/results/`` is the proof of
     #: support on a given backend.
     offload_optimizer: bool = False
+    #: bucket-group count for the scheduled compressed gradient sync
+    #: (see ``parallel.compression.sync_gradients``): None defers to
+    #: ``CommsConfig.groups`` (the ``TPUFRAME_COMMS_GROUPS`` env knob);
+    #: an explicit value pins the schedule on the plan so it rides the
+    #: plan signature, the topology manifest, and the compile labels.
+    comms_groups: int | None = None
 
     def __post_init__(self):
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_stage must be 0..3, got {self.zero_stage}")
+        if self.comms_groups is not None and self.comms_groups < 1:
+            raise ValueError(
+                f"comms_groups must be >= 1 (or None), got {self.comms_groups}"
+            )
         if self.offload_optimizer and not host_memory_available(self.mesh):
             # loud, not silent: a user who asked for DeepSpeed-style CPU
             # offload must know their optimizer state is staying in HBM
@@ -185,8 +195,32 @@ class ParallelPlan:
             "data_axes": list(self.data_axes),
             "offload": bool(self.offload_optimizer),
         }
+        # schedule-bearing plans key their own programs; the default
+        # (None / 1 = single-shot) is OMITTED so every pre-existing plan
+        # signature — autotune store keys, topology manifests, compile
+        # labels — is unchanged by the field's existence
+        if self.comms_groups is not None and self.comms_groups != 1:
+            payload["comms_groups"] = int(self.comms_groups)
         blob = json.dumps(payload, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:12]
+
+    def comms_schedule(self, config: Any = None) -> dict:
+        """The plan's collective schedule as a first-class artifact:
+        how many bucket groups the compressed gradient sync fires, and
+        in what order.  ``config`` (a ``CommsConfig``) supplies the env
+        default when the plan itself doesn't pin ``comms_groups``.
+        ``order`` is fixed: groups fire in reverse path-sorted bucket
+        order — the reverse-backward leaf order, so the group covering
+        the gradients backward produces *first* goes on the wire first
+        and hides behind the rest of the backward."""
+        groups = self.comms_groups
+        if groups is None:
+            groups = int(getattr(config, "groups", 1) or 1)
+        return {
+            "groups": int(groups),
+            "order": "reverse_backward",
+            "pinned": self.comms_groups is not None,
+        }
 
     def describe_topology(self) -> dict:
         """The plan's topology as manifest-shaped JSON (mesh axes, world

@@ -692,6 +692,10 @@ def skew_report(ranks: Sequence[RankLog], *,
             "bytes_per_step": w.get("bytes_per_step"),
             "f32_bytes_per_step": w.get("f32_bytes_per_step"),
             "reduction_x": w.get("reduction_x"),
+            # the declared collective schedule (bucket groups fired in
+            # reverse-backward order); bytes are invariant under it,
+            # exposed-comms in the device_time block is what it moves
+            "overlap_groups": w.get("overlap_groups"),
             "steps": steps_total,
             "bytes_on_wire": (
                 (w.get("bytes_per_step") or 0) * steps_total
@@ -1001,11 +1005,17 @@ def format_report(report: dict, diff: dict | None = None, *,
             f" ({cm['reduction_x']}x under f32)"
             if cm.get("reduction_x") else ""
         )
+        og = cm.get("overlap_groups")
+        grp = (
+            f", {og} bucket group(s) (reverse-backward fire order)"
+            if og and og > 1 else ""
+        )
         lines.append(
             f"  comms: {cm.get('mode')} wire, "
             f"{(cm.get('bytes_per_step') or 0) / 1e6:.3f} MB/step{red}, "
             f"{(cm.get('bytes_on_wire') or 0) / 1e6:.1f} MB over "
             f"{cm.get('steps', 0)} rank-step(s)"
+            + grp
             + (
                 f", allreduce p50="
                 f"{cm['allreduce_s']['p50'] * 1e3:.2f}ms"

@@ -212,12 +212,17 @@ def _is_exec_track(pname: str, tname: str) -> bool:
     count.  CPU traces have no device process — XLA:CPU op execution
     lands on ``tf_XLATfrtCpuClient/<tid>`` threads of the host process
     (the ``python`` thread's nested durations are host bookkeeping, not
-    device time).
+    device time) AND on the ``tf_XLAEigen/<tid>`` intra-op pool, which
+    is where the thunk runtime actually runs the named HLO ops —
+    including every collective (an all-reduce under simulated multi-CPU
+    appears ONLY there).  Both pools belong to one host process, so
+    their events merge into one device timeline; ``classify_op`` drops
+    the pools' ``::`` bookkeeping spans, leaving the real ops.
     """
     t = tname.lower()
     if pname.startswith("/device:"):
         return "step" not in t and "module" not in t
-    return "xlatfrtcpuclient" in t
+    return "xlatfrtcpuclient" in t or "xlaeigen" in t
 
 
 def _tracks(trace: dict) -> dict[tuple[Any, Any], dict]:
@@ -305,8 +310,10 @@ def _union_len(union: Sequence[tuple[float, float]]) -> float:
 # -- the device_time record ---------------------------------------------------
 
 #: bump when the record shape changes (the skew report embeds it; the
-#: golden fixture test pins the keys)
-DEVICE_TIME_VERSION = "1.0"
+#: golden fixture test pins the keys).  1.1: CPU exec-track selection
+#: widened to the ``tf_XLAEigen`` intra-op pool — CPU captures now see
+#: their collectives, so ``overlap_efficiency`` is measurable off-chip.
+DEVICE_TIME_VERSION = "1.1"
 
 _CLASSES = ("compute", "collective", "transfer")
 

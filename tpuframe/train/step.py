@@ -355,6 +355,17 @@ def _make_compressed_train_step(
     ``TrainState.comms`` residual (``init_comms_state``); a state
     without one runs compressed-without-EF, loudly
     (``comms/ef_inactive``).
+
+    **Overlapped flavor** (``plan.comms_groups`` > 1 or
+    ``TPUFRAME_COMMS_GROUPS``): the sync fires as the layout's
+    bucket-group schedule (reverse-backward order, one collective per
+    group — see :func:`~tpuframe.parallel.compression.sync_gradients`),
+    and the grad-accum path peels the last microbatch out of the scan
+    so the groups overlap its open backward graph.  Pair with
+    ``TPUFRAME_COMMS_ASYNC=1`` so XLA's latency-hiding scheduler
+    actually moves the independent collectives into the compute gaps.
+    Bit-exact against the single-shot step; the schedule rides
+    ``comms/wire_plan`` as the ``overlap_groups``/``groups`` block.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -491,11 +502,29 @@ def _make_compressed_train_step(
                     "correct": jnp.zeros(()),
                     "count": jnp.zeros(()),
                 }
-                (grads, new_stats, metrics), _ = jax.lax.scan(
-                    micro,
-                    (zero_grads, state.batch_stats, init_metrics),
-                    (batch, jnp.arange(n_microbatches)),
-                )
+                carry0 = (zero_grads, state.batch_stats, init_metrics)
+                if layout.n_groups > 1:
+                    # microbatch interleave: peel the LAST microbatch out
+                    # of the scan and inline its VJP, so the grouped sync
+                    # below depends on the scan result plus an OPEN
+                    # backward graph — group i's collective needs only
+                    # its own leaves' final grads and can go on the wire
+                    # while the peeled VJP is still producing the rest.
+                    # Addition order is the scan's exactly
+                    # (((g0+g1)+...)+g_{n-1}), so grads are bit-identical
+                    # to the unpeeled scan.
+                    head = jax.tree.map(lambda x: x[:-1], batch)
+                    carry, _ = jax.lax.scan(
+                        micro, carry0, (head, jnp.arange(n_microbatches - 1))
+                    )
+                    tail = jax.tree.map(lambda x: x[-1], batch)
+                    (grads, new_stats, metrics), _ = micro(
+                        carry, (tail, jnp.int32(n_microbatches - 1))
+                    )
+                else:
+                    (grads, new_stats, metrics), _ = jax.lax.scan(
+                        micro, carry0, (batch, jnp.arange(n_microbatches))
+                    )
                 grads = jax.tree.map(lambda g: g / n_microbatches, grads)
                 loss = metrics["loss_sum"] / jnp.maximum(metrics["count"], 1.0)
 

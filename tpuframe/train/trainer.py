@@ -543,6 +543,12 @@ class Trainer:
             tele.registry.gauge("comms/bytes_per_step").set(
                 wire["bytes_per_step"]
             )
+            # the declared collective schedule: >1 means the sync fires
+            # as that many bucket groups in reverse-backward order (bytes
+            # are invariant under grouping; exposed-comms is what moves)
+            tele.registry.gauge("comms/overlap_groups").set(
+                wire.get("overlap_groups") or 1
+            )
             self._comms_gauge_set = True
         tele.registry.counter("comms/bytes_on_wire").inc(
             wire["bytes_per_step"]
