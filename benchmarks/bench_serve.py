@@ -116,9 +116,13 @@ def closed_loop(engine, payloads, clients: int, per_client: int):
     return time.perf_counter() - t0, lats, errors
 
 
-def http_closed_loop(url: str, blobs, clients: int, per_client: int):
+def http_closed_loop(url: str, blobs, clients: int, per_client: int,
+                     trace_prefix: str | None = None):
     """Closed-loop over real HTTP: ``clients`` threads POSTing ``.npy``
-    bodies back-to-back at ``url``/predict.  Returns
+    bodies back-to-back at ``url``/predict.  ``trace_prefix`` arms
+    request-path tracing: each request carries a distinct
+    ``X-Trace-Id`` (the traced arm of the overhead A/B — without it the
+    request path emits nothing extra).  Returns
     (wall_s, server_latencies_s, status_counts)."""
     import urllib.error
     import urllib.request
@@ -130,9 +134,12 @@ def http_closed_loop(url: str, blobs, clients: int, per_client: int):
     def client(ci: int) -> None:
         for i in range(per_client):
             body = blobs[(ci * per_client + i) % len(blobs)]
+            headers = {"Content-Type": "application/octet-stream"}
+            if trace_prefix is not None:
+                headers["X-Trace-Id"] = f"{trace_prefix}-{ci}-{i}"
             req = urllib.request.Request(
                 url + "/predict", data=body, method="POST",
-                headers={"Content-Type": "application/octet-stream"},
+                headers=headers,
             )
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
@@ -171,17 +178,25 @@ def _fabricate_healthy_ckpt(dirpath: str) -> str:
 
 
 def run_fleet(args, served, payloads, backend: str,
-              device_kind: str) -> dict:
+              device_kind: str) -> tuple[dict, dict]:
     import io as _io
+    import shutil
 
     import numpy as np
 
     from tpuframe.serve import ReplicaSet, ServeKnobs, ServingServer
     from tpuframe.serve.engine import ServeEngine
     from tpuframe.serve.router import FleetKnobs
-    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.track import telemetry as T
 
-    reg = get_telemetry().registry
+    # arm request-path tracing: every hop span from here lands in one
+    # telemetry dir the analyzer turns into the serve_trace block and a
+    # Perfetto timeline after the run
+    trace_dir = os.path.join(args.workdir, "trace_telemetry")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    os.makedirs(trace_dir, exist_ok=True)
+    T.configure(jsonl_dir=trace_dir, rank=0)
+    reg = T.get_telemetry().registry
     recompiles0 = reg.counter("compile/recompiles").value
     buckets = tuple(int(b) for b in args.buckets.split(","))
     knobs = ServeKnobs(buckets=buckets, slo_ms=args.slo_ms,
@@ -199,6 +214,33 @@ def run_fleet(args, served, payloads, backend: str,
     eng = ServeEngine(served, knobs=knobs).start()
     srv = ServingServer(eng)
     http_closed_loop(srv.url, blobs[:1], 1, 1)  # warmup round-trip
+    # tracing overhead A/B: same replica, same load, interleaved rounds;
+    # the replica only emits hop records when the header arrives, so the
+    # untraced arm is the exact pre-trace request path.  Min-of-rounds
+    # p99 per arm damps scheduler noise on a shared box.
+    ab_off: list[float] = []
+    ab_on: list[float] = []
+    # enough samples that the arm p99 is an interior order statistic,
+    # not a max — 4 clients x ab_n requests per round per arm
+    ab_n = max(100, per_client)
+    http_closed_loop(srv.url, blobs, 4, 4, trace_prefix="warm")  # arm warmup
+    for rnd in range(4):
+        _, l_off, _ = http_closed_loop(srv.url, blobs, 4, ab_n)
+        _, l_on, _ = http_closed_loop(srv.url, blobs, 4, ab_n,
+                                      trace_prefix=f"ab{rnd}")
+        ab_off.append(_latency_block(l_off)["p99"])
+        ab_on.append(_latency_block(l_on)["p99"])
+    trace_overhead = {
+        "untraced_p99_ms": round(min(ab_off) * 1e3, 3),
+        "traced_p99_ms": round(min(ab_on) * 1e3, 3),
+    }
+    trace_overhead["overhead_ratio"] = round(
+        trace_overhead["traced_p99_ms"]
+        / max(1e-9, trace_overhead["untraced_p99_ms"]), 4)
+    trace_overhead["within_2pct"] = trace_overhead["overhead_ratio"] <= 1.02
+    print(f"# tracing overhead: off={trace_overhead['untraced_p99_ms']}ms "
+          f"on={trace_overhead['traced_p99_ms']}ms p99 "
+          f"(x{trace_overhead['overhead_ratio']})", file=sys.stderr)
     wall, lats, statuses = http_closed_loop(srv.url, blobs, 8, per_client)
     eng.drain(timeout=30)
     srv.close()
@@ -273,7 +315,74 @@ def run_fleet(args, served, payloads, backend: str,
               f"p99={rolling['during_promotion_p99_ms']}ms", file=sys.stderr)
 
     recompiles = reg.counter("compile/recompiles").value - recompiles0
-    return {
+
+    # ---- request-path attribution off the traced run ---------------------
+    T.reset()  # flush + close the JSONL writers before reading them back
+    import tpuframe.track.analyze as A
+
+    ranks = A.load_dirs([trace_dir])
+    trace_report = A.skew_report(ranks)
+    st = trace_report["serve_trace"] or {}
+    perfetto_path = os.path.join(args.workdir, "bench_serve_perfetto.json")
+    with open(perfetto_path, "w") as f:
+        json.dump(A.build_trace(ranks), f)
+    # per-hop p99 sum vs measured e2e p99: the engine-side hops
+    # (queue_wait + assemble + infer) tile the served latency, so their
+    # p99 sum must land near the e2e p99 — the attribution sanity check
+    hops = st.get("hops") or {}
+    e2e_p99 = (st.get("e2e") or {}).get("p99")
+    hop_sum = sum((hops.get(h) or {}).get("p99") or 0.0
+                  for h in ("queue_wait", "assemble", "infer"))
+    hop_sum_vs_e2e = {
+        "hops": ["queue_wait", "assemble", "infer"],
+        "hop_p99_sum_ms": round(hop_sum * 1e3, 3),
+        "e2e_p99_ms": round((e2e_p99 or 0.0) * 1e3, 3),
+        "ratio": round(hop_sum / e2e_p99, 4) if e2e_p99 else None,
+    }
+    # the deepest trace (most distinct hops): the committed witness that
+    # one request's spans line up across router/replica/engine
+    per_trace: dict = {}
+    for rk in ranks:
+        for ev in rk.events:
+            hop = A._TRACE_HOP_SPANS.get(ev.get("name"))
+            if hop is None:
+                continue
+            attrs = ev.get("attrs") or {}
+            dur = float(ev.get("dur_s") or attrs.get("dur_s") or 0.0)
+            one = ev.get("trace") or attrs.get("trace")
+            many = ev.get("traces") or attrs.get("traces") or []
+            for tid in ([one] if one else []) + list(many):
+                row = per_trace.setdefault(tid, {})
+                row[hop] = round(row.get(hop, 0.0) + dur, 6)
+    trace_sample = {"trace": None, "hops": {}}
+    if per_trace:
+        best = max(per_trace, key=lambda t: len(per_trace[t]))
+        trace_sample = {"trace": best, "hops": per_trace[best]}
+    print(f"# serve_trace: {st.get('traces', 0)} traced requests, "
+          f"hop-sum/e2e p99 ratio {hop_sum_vs_e2e['ratio']}, "
+          f"perfetto -> {perfetto_path}", file=sys.stderr)
+
+    trace_record = {
+        "metric": "serve_trace_request_path",
+        "value": hop_sum_vs_e2e["e2e_p99_ms"],
+        "unit": ("fleet-served e2e p99 ms with per-hop request-path "
+                 "attribution (router-minted trace ids, buckets "
+                 f"{list(buckets)}, {backend})"),
+        "backend": backend,
+        "device_kind": device_kind,
+        "buckets": list(buckets),
+        "slo_ms": args.slo_ms,
+        # the baseline-gated blocks: queue-wait p99 + SLO burn rate ride
+        # `serve_trace` (ratio_queue_wait_p99 / ratio_burn_rate, exit 3)
+        "serve_trace": st or None,
+        "trace_overhead": trace_overhead,
+        "hop_sum_vs_e2e": hop_sum_vs_e2e,
+        "trace_sample": trace_sample,
+        "recompile_events": int(recompiles),
+        "telemetry_dir": trace_dir,
+        "perfetto_trace": perfetto_path,
+    }
+    fleet_record = {
         "metric": "serve_fleet_throughput_rps",
         "value": fleet_run["rps"],
         "unit": ("closed-loop HTTP requests/s through the router over 3 "
@@ -292,6 +401,7 @@ def run_fleet(args, served, payloads, backend: str,
         "rolling_restart": rolling,
         "recompile_events": int(recompiles),
     }
+    return fleet_record, trace_record
 
 
 def main() -> int:
@@ -340,7 +450,15 @@ def main() -> int:
                 for _ in range(32)]
 
     if args.fleet:
-        record = run_fleet(args, served, payloads, backend, device_kind)
+        record, trace_record = run_fleet(args, served, payloads, backend,
+                                         device_kind)
+        trace_path = os.path.join(args.workdir, "bench_serve_trace.json")
+        with open(trace_path, "w") as f:
+            json.dump(trace_record, f, indent=1)
+            f.write("\n")
+        print(f"# trace record -> {trace_path} "
+              "(commit as benchmarks/results/bench_serve_trace_cpu.json "
+              "on CPU)", file=sys.stderr)
         print(json.dumps(record))
         return 0
 

@@ -206,6 +206,19 @@ run bench_serve.json           300  python benchmarks/bench_serve.py
 # just below the single-engine serve rung it extends
 run bench_serve_fleet.json     300  python benchmarks/bench_serve.py --fleet
 
+# request-path trace rung: the fleet topology again with tracing armed
+# end to end — per-hop attribution (router pick, forward hop, door,
+# queue wait, assemble, infer, respond), the traced-vs-untraced served
+# p99 A/B (overhead must hold under 2%), and the SLO burn-rate block.
+# The committed serve_trace record is what `track analyze --baseline`
+# gates ratio_queue_wait_p99 / ratio_burn_rate against (exit 3 — the
+# queue-wait p99 is the autoscaler's signal, the burn rate is the SLO
+# plane's; SERVE.md "SLO objectives").  The fleet bench writes the
+# trace record as a side file next to its workdir, so this rung replays
+# it into its own stdout artifact.
+run bench_serve_trace.json     300  bash -c \
+  'python benchmarks/bench_serve.py --fleet --workdir /tmp/tpuframe_trace_rung >/dev/null && cat /tmp/tpuframe_trace_rung/bench_serve_trace.json'
+
 # wire-collectives rung: bytes-on-wire (static ring model, backend-
 # independent) + the MEASURED compressed-allreduce wall and matched A/B
 # step time on the real chip — the committed `comms` block is what

@@ -585,3 +585,691 @@ class TestFleetBenchRecord:
         assert rec["serve_latency"]["count"] > 0
         assert rec["rolling_restart"]["dropped_in_flight"] == 0
         assert rec["rolling_restart"]["p99_under_slo"] is True
+
+
+# ===========================================================================
+# request-path tracing + SLO plane (PR 16)
+# ===========================================================================
+
+
+def _post_traced(url, blob, headers=None, timeout=10.0):
+    hdrs = {"Content-Type": "application/octet-stream"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url + "/predict", data=blob, method="POST",
+                                 headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _jsonl_events(d):
+    import glob
+
+    evs = []
+    for p in sorted(glob.glob(os.path.join(str(d), "events-rank*.jsonl"))):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        evs.append(json.loads(line))
+                    except ValueError:
+                        pass  # torn trailing line
+    return evs
+
+
+def _event_trace_ids(ev):
+    """Trace ids an event belongs to: a per-request ``trace`` field
+    (top-level or span attrs) or a batch-scoped ``traces`` fan-out."""
+    attrs = ev.get("attrs") or {}
+    one = ev.get("trace") or attrs.get("trace")
+    many = ev.get("traces") or attrs.get("traces") or []
+    return ([one] if one else []) + list(many)
+
+
+class TestTraceIdSanitizer:
+    def test_accepts_sane_ids_and_strips(self):
+        from tpuframe.serve import sanitize_trace_id
+
+        assert sanitize_trace_id("abc-123_X.y") == "abc-123_X.y"
+        assert sanitize_trace_id("  ok  ") == "ok"
+
+    def test_rejects_garbage(self):
+        from tpuframe.serve import sanitize_trace_id
+
+        assert sanitize_trace_id(None) is None
+        assert sanitize_trace_id("") is None
+        assert sanitize_trace_id("   ") is None
+        assert sanitize_trace_id("evil\nheader") is None
+        assert sanitize_trace_id("x" * 65) is None
+        assert sanitize_trace_id(123) is None
+
+
+class TestTracePropagation:
+    def test_client_trace_spans_router_to_engine(self, tmp_path):
+        """One client-supplied trace id must appear on every hop from the
+        router's pick to the response write — the tentpole story."""
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.serve.router import Router
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            fn, _ = _linear_model()
+            eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                              dtype="float32").start()
+            srv = ServingServer(eng, port=0)
+            router = Router([srv.url]).start()
+            try:
+                status, doc, headers = _post_traced(
+                    router.url, _blob(),
+                    headers={"X-Trace-Id": "trace-fleet-1"})
+                assert status == 200
+                assert headers["X-Trace-Id"] == "trace-fleet-1"
+            finally:
+                router.close()
+                srv.close()
+                eng.stop()
+        finally:
+            T.reset()
+        names = {e["name"] for e in _jsonl_events(tmp_path)
+                 if "trace-fleet-1" in _event_trace_ids(e)}
+        assert {"fleet/route", "fleet/hop", "serve/door", "serve/queue_wait",
+                "serve/assemble", "serve/infer", "serve/respond"} <= names
+
+    def test_router_mints_when_client_sends_none(self):
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.serve.router import Router
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                          dtype="float32").start()
+        srv = ServingServer(eng, port=0)
+        router = Router([srv.url]).start()
+        try:
+            status, _, headers = _post_traced(router.url, _blob())
+            assert status == 200
+            minted = headers["X-Trace-Id"]
+            assert len(minted) == 16
+            int(minted, 16)  # hex
+            # a garbage client id is replaced by a minted one, not echoed
+            status, _, headers = _post_traced(
+                router.url, _blob(), headers={"X-Trace-Id": "x" * 65})
+            assert status == 200
+            assert len(headers["X-Trace-Id"]) == 16
+        finally:
+            router.close()
+            srv.close()
+            eng.stop()
+
+    def test_direct_server_hit_is_untraced(self):
+        """The replica propagates but never mints: a direct hit without
+        the header is the traced-off baseline (no response header, no
+        hop records)."""
+        from tpuframe.serve import ServeEngine, ServingServer
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                          dtype="float32").start()
+        srv = ServingServer(eng, port=0)
+        try:
+            status, _, headers = _post(srv.url, _blob())
+            assert status == 200
+            assert "X-Trace-Id" not in headers
+        finally:
+            srv.close()
+            eng.stop()
+
+    def test_server_echoes_and_engine_records_client_trace(self, tmp_path):
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            fn, _ = _linear_model()
+            eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                              dtype="float32").start()
+            srv = ServingServer(eng, port=0)
+            try:
+                status, _, headers = _post_traced(
+                    srv.url, _blob(), headers={"X-Trace-Id": "direct-1"})
+                assert status == 200
+                assert headers["X-Trace-Id"] == "direct-1"
+            finally:
+                srv.close()
+                eng.stop()
+        finally:
+            T.reset()
+        tagged = [e for e in _jsonl_events(tmp_path)
+                  if "direct-1" in _event_trace_ids(e)]
+        names = {e["name"] for e in tagged}
+        assert {"serve/door", "serve/queue_wait", "serve/assemble",
+                "serve/infer", "serve/respond"} <= names
+        # the served request record itself carries the trace id too
+        assert any(e["name"] == "serve/request" for e in tagged)
+
+
+class TestMarkdownMarkupEvents:
+    def test_mark_down_emits_event_and_counter(self, tmp_path):
+        from tpuframe.serve.router import Router, _Backend
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            r = Router()
+            b = _Backend("http://x:1")
+            b.healthy = True
+            r._backends[b.url] = b
+            before = r._c_markdowns.value
+            r._mark_down(b.url, "connect-refused")
+            assert r._c_markdowns.value == before + 1
+            # a second mark-down of an already-down replica is a no-op
+            r._mark_down(b.url, "connect-refused")
+            assert r._c_markdowns.value == before + 1
+        finally:
+            T.reset()
+        evs = [e for e in _jsonl_events(tmp_path)
+               if e["name"] == "fleet/markdown"]
+        assert len(evs) == 1
+        assert evs[0]["replica"] == "http://x:1"
+        assert evs[0]["reason"] == "connect-refused"
+
+    def test_probe_transitions_emit_markdown_and_markup(self, tmp_path):
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.serve.router import Router
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            fn, _ = _linear_model()
+            eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                              dtype="float32").start()
+            srv = ServingServer(eng, port=0)
+            r = Router()
+            try:
+                r.add_backend(srv.url)  # probes inline: up-transition
+                assert r.healthy_backends() == [srv.url]
+                srv.close()             # kill the replica out from under it
+                r._probe_once()         # down-transition
+                assert r.healthy_backends() == []
+            finally:
+                r.close()
+                srv.close()
+                eng.stop()
+        finally:
+            T.reset()
+        evs = _jsonl_events(tmp_path)
+        ups = [e for e in evs if e["name"] == "fleet/markup"]
+        downs = [e for e in evs if e["name"] == "fleet/markdown"]
+        assert any(e["replica"] == srv.url and e["reason"] == "probe"
+                   for e in ups)
+        assert any(e["replica"] == srv.url and e["reason"] == "probe"
+                   for e in downs)
+
+
+class TestRouterMetricsAggregation:
+    def test_one_scrape_returns_replica_labeled_gauges(self):
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.serve.router import Router
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                          dtype="float32").start()
+        srv = ServingServer(eng, port=0)
+        router = Router([srv.url]).start()
+        try:
+            with urllib.request.urlopen(router.url + "/metrics",
+                                        timeout=5) as resp:
+                text = resp.read().decode()
+            label = '{replica="' + srv.url + '"}'
+            assert f"tpuframe_serve_queue_depth{label}" in text
+            assert f"tpuframe_fleet_replica_healthy{label} 1" in text
+            assert f"tpuframe_fleet_replica_draining{label} 0" in text
+            assert f"tpuframe_fleet_replica_ewma_seconds{label}" in text
+            # the fleet-wide SLO aggregate rides the same page
+            assert "tpuframe_slo_burn_rate" in text
+            assert "tpuframe_slo_error_budget" in text
+        finally:
+            router.close()
+            srv.close()
+            eng.stop()
+
+    def test_labeled_lines_do_not_fool_the_depth_scraper(self):
+        """A router scraped as if it were a replica must not leak a
+        labeled per-replica depth into the unlabeled-gauge fallback."""
+        from tpuframe.serve.router import Router, _Backend
+
+        r = Router()
+        b = _Backend("http://x:1")
+        b.healthy, b.queue_depth = True, 7
+        r._backends[b.url] = b
+        for line in r._fleet_metrics_text().splitlines():
+            assert not line.startswith("tpuframe_serve_queue_depth ")
+
+    def test_healthz_reports_green_count(self):
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.serve.router import Router
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                          dtype="float32").start()
+        srv = ServingServer(eng, port=0)
+        router = Router([srv.url]).start()
+        try:
+            with urllib.request.urlopen(router.url + "/healthz",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert doc["healthy"] == 1 and doc["green"] == 1
+            eng.drain(timeout=10.0)   # healthy but draining: not green
+            router._probe_once()
+            with urllib.request.urlopen(router.url + "/healthz",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert doc["green"] == 0
+        finally:
+            router.close()
+            srv.close()
+            eng.stop()
+
+
+class TestRetryAfterClampBounds:
+    """Satellite: the Retry-After estimate is clamped to [1, 30] at both
+    ends, whatever the queue/batch-wait arithmetic says."""
+
+    class _FakeEngine:
+        item_shape = (2,)
+        dtype = "float32"
+        buckets = (1, 4)
+        draining = False
+
+        def __init__(self, depth, batch_wait_ms):
+            import types
+
+            self._depth = depth
+            self.knobs = types.SimpleNamespace(batch_wait_ms=batch_wait_ms)
+
+        def queue_depth(self):
+            return self._depth
+
+    def _retry_after(self, depth, batch_wait_ms):
+        from tpuframe.serve import ServingServer
+
+        srv = ServingServer(self._FakeEngine(depth, batch_wait_ms), port=0)
+        try:
+            return int(srv._retry_after()["Retry-After"])
+        finally:
+            srv.close()
+
+    def test_huge_backlog_clamps_to_30(self):
+        assert self._retry_after(10_000, 60_000.0) == 30
+
+    def test_idle_engine_clamps_up_to_1(self):
+        assert self._retry_after(0, 0.0) == 1
+
+    def test_mid_range_is_the_honest_estimate(self):
+        # 40 queued / bucket 4 = 10 batches x 500ms = 5s
+        assert self._retry_after(40, 500.0) == 5
+
+
+class TestHealthzUnderActiveDrain:
+    def test_depth_and_draining_visible_mid_drain(self):
+        """Satellite: /healthz must report ``draining: true`` and the
+        live queue depth WHILE a drain is in progress, not only after.
+        The engine loop is started late so the queued work is pinned in
+        place while we scrape."""
+        import threading
+        import time
+
+        from tpuframe.serve import ServeEngine, ServingServer
+
+        fn, _ = _linear_model()
+        eng = ServeEngine(fn, knobs=_knobs(slo_ms=30000), item_shape=(4, 3),
+                          dtype="float32")
+        # gate the batching loop so the queued work is pinned in place
+        # while we scrape mid-drain
+        gate = threading.Event()
+        orig_gather = eng._gather
+
+        def gated_gather():
+            gate.wait(30.0)
+            return orig_gather()
+
+        eng._gather = gated_gather
+        eng.start()
+        srv = ServingServer(eng, port=0)
+        try:
+            results = [eng.submit(np.random.RandomState(i).rand(4, 3)
+                                  .astype(np.float32)) for i in range(5)]
+            assert eng.queue_depth() == 5
+            t = threading.Thread(target=eng.drain,
+                                 kwargs={"timeout": 30.0}, daemon=True)
+            t.start()
+            hz = None
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(srv.url + "/healthz",
+                                            timeout=5) as resp:
+                    hz = json.loads(resp.read())
+                if hz["draining"]:
+                    break
+                time.sleep(0.01)
+            assert hz is not None and hz["draining"] is True
+            assert hz["status"] == "draining"
+            assert hz["queue_depth"] == 5  # queued work visible mid-drain
+            gate.set()  # now let the loop run the queue down
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "drain never finished"
+            for res in results:
+                res.result(timeout=10)
+            with urllib.request.urlopen(srv.url + "/healthz",
+                                        timeout=5) as resp:
+                hz = json.loads(resp.read())
+            assert hz["queue_depth"] == 0
+        finally:
+            srv.close()
+            eng.stop()
+
+
+class TestSloPlane:
+    def test_burn_rate_math(self):
+        from tpuframe.serve import SloObjectives, SloTracker
+
+        t = SloTracker(SloObjectives(p99_ms=500.0, availability=0.999),
+                       window_s=60.0)
+        for _ in range(10):
+            t.observe(0.1)       # well under the objective
+        t.observe(0.9)           # latency violation
+        t.observe(ok=False)      # availability violation
+        snap = t.snapshot()
+        assert snap["requests"] == 12 and snap["violations"] == 2
+        assert snap["burn_rate"] == pytest.approx((2 / 12) / 0.001, rel=1e-3)
+        assert snap["error_budget_remaining"] == 0.0
+
+    def test_clean_window_has_zero_burn(self):
+        from tpuframe.serve import SloObjectives, SloTracker
+
+        t = SloTracker(SloObjectives(p99_ms=500.0, availability=0.999))
+        for _ in range(5):
+            t.observe(0.01)
+        snap = t.snapshot()
+        assert snap["burn_rate"] == 0.0
+        assert snap["error_budget_remaining"] == 1.0
+
+    def test_gauges_ride_the_registry(self, tmp_path):
+        from tpuframe.serve import SloTracker
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            t = SloTracker()
+            t.observe(ok=False)
+            reg = T.get_telemetry().registry
+            assert reg.gauge("slo/burn_rate").value > 0
+            assert reg.gauge("slo/error_budget").value == 0.0
+            assert "tpuframe_slo_burn_rate" in reg.prometheus_text()
+        finally:
+            T.reset()
+
+    def test_objectives_event_logged_at_construction(self, tmp_path):
+        from tpuframe.serve import SloObjectives, SloTracker
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            SloTracker(SloObjectives(p99_ms=250.0, availability=0.99),
+                       source="engine")
+        finally:
+            T.reset()
+        evs = [e for e in _jsonl_events(tmp_path)
+               if e["name"] == "slo/objectives"]
+        assert evs and evs[0]["p99_ms"] == 250.0
+        assert evs[0]["availability"] == 0.99
+        assert evs[0]["source"] == "engine"
+
+    def test_from_env_tolerant_vs_strict(self, monkeypatch):
+        from tpuframe.serve import SloObjectives
+
+        monkeypatch.setenv("TPUFRAME_SLO_P99_MS", "banana")
+        assert SloObjectives.from_env().p99_ms == SloObjectives().p99_ms
+        with pytest.raises(ValueError, match="banana"):
+            SloObjectives.from_env(strict=True)
+
+    def test_strict_range_validation(self, monkeypatch):
+        from tpuframe.serve import SloObjectives
+
+        monkeypatch.setenv("TPUFRAME_SLO_AVAILABILITY", "2.5")
+        with pytest.raises(ValueError, match=r"\(0, 1\]"):
+            SloObjectives.from_env(strict=True)
+
+    def test_env_overrides_apply(self, monkeypatch):
+        from tpuframe.serve import SloObjectives
+
+        monkeypatch.setenv("TPUFRAME_SLO_P99_MS", "250")
+        monkeypatch.setenv("TPUFRAME_SLO_AVAILABILITY", "0.99")
+        obj = SloObjectives.from_env()
+        assert obj.p99_ms == 250.0 and obj.availability == 0.99
+
+    def test_slo_knobs_are_registered(self):
+        from tpuframe.serve.admission import SERVE_ENV_DOMAINS, SERVE_ENV_VARS
+
+        for var in ("TPUFRAME_SLO_P99_MS", "TPUFRAME_SLO_AVAILABILITY"):
+            assert var in SERVE_ENV_VARS
+            assert var in SERVE_ENV_DOMAINS
+            assert SERVE_ENV_DOMAINS[var]["type"] == "float"
+
+
+class TestDoctorSloSection:
+    def test_section_shape(self, monkeypatch):
+        from tpuframe.doctor import slo_section
+
+        monkeypatch.setenv("TPUFRAME_SLO_P99_MS", "250")
+        sec = slo_section()
+        assert sec["objectives"]["p99_ms"] == 250.0
+        assert sec["env"] == {"TPUFRAME_SLO_P99_MS": "250"}
+        assert isinstance(sec["burn_rate"], float)
+        assert isinstance(sec["error_budget_remaining"], float)
+        assert sec["analyze"].startswith("python -m tpuframe.track analyze")
+
+    def test_malformed_env_reported_not_crashed(self, monkeypatch):
+        from tpuframe.doctor import slo_section
+
+        monkeypatch.setenv("TPUFRAME_SLO_AVAILABILITY", "2.5")
+        sec = slo_section()
+        assert "2.5" in sec["objectives"]["error"]
+
+    def test_report_includes_slo(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        from tpuframe.doctor import report
+
+        assert "slo" in report(probe_timeout_s=60)
+
+
+class TestAnalyzeServeTrace:
+    def _traced_run(self, tmp_path, n=8):
+        from tpuframe.serve import ServeEngine, ServingServer
+        from tpuframe.serve.router import Router
+        from tpuframe.track import telemetry as T
+
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            fn, _ = _linear_model()
+            eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                              dtype="float32").start()
+            srv = ServingServer(eng, port=0)
+            router = Router([srv.url]).start()
+            try:
+                for i in range(n):
+                    status, _, _ = _post_traced(router.url, _blob(i))
+                    assert status == 200
+            finally:
+                router.close()
+                srv.close()
+                eng.stop()
+        finally:
+            T.reset()
+
+    def test_skew_report_builds_serve_trace_block(self, tmp_path):
+        import tpuframe.track.analyze as A
+
+        self._traced_run(tmp_path)
+        report = A.skew_report(A.load_dir(str(tmp_path)))
+        tr = report["serve_trace"]
+        assert tr and tr["version"] == A.SERVE_TRACE_VERSION
+        assert tr["traces"] == 8
+        for hop in ("route", "hop", "door", "queue_wait", "assemble",
+                    "infer", "respond"):
+            assert tr["hops"][hop]["count"] >= 8, hop
+            assert tr["hops"][hop]["p50"] <= tr["hops"][hop]["p99"]
+        assert tr["e2e"]["count"] == 8
+        assert tr["retry_amplification"] >= 1.0
+        assert 0.0 <= tr["queue_wait_share"] <= 1.0
+        assert tr["slo"]["requests"] == 8
+        # engine-side hops must tile inside the measured end-to-end time
+        engine_side = sum(tr["hops"][h]["p50"]
+                          for h in ("queue_wait", "assemble", "infer"))
+        assert engine_side <= tr["e2e"]["p99"] * 1.5
+        text = A.format_report(report)
+        assert "request path" in text and "burn rate" in text
+
+    def test_untraced_run_has_null_block(self, tmp_path):
+        from tpuframe.serve import ServeEngine
+        from tpuframe.track import telemetry as T
+        import tpuframe.track.analyze as A
+
+        fn, _ = _linear_model()
+        T.configure(jsonl_dir=str(tmp_path), rank=0)
+        try:
+            eng = ServeEngine(fn, knobs=_knobs(), item_shape=(4, 3),
+                              dtype="float32")
+            with eng:
+                for i in range(3):
+                    eng.submit(np.random.RandomState(i).rand(4, 3)
+                               .astype(np.float32)).result(timeout=10)
+        finally:
+            T.reset()
+        report = A.skew_report(A.load_dir(str(tmp_path)))
+        # requests flowed but nothing armed tracing: block absent, and
+        # the contract keys still pin
+        assert report["serve_trace"] is None
+        assert set(report) == set(A.SKEW_REPORT_KEYS)
+
+    def test_perfetto_trace_carries_trace_ids(self, tmp_path):
+        import tpuframe.track.analyze as A
+
+        self._traced_run(tmp_path, n=2)
+        ranks = A.load_dir(str(tmp_path))
+        doc = A.build_trace(ranks)
+        blob = json.dumps(doc)
+        # router-minted ids (16 hex chars) are searchable in the args
+        route = [e for e in _jsonl_events(tmp_path)
+                 if e["name"] == "fleet/route"]
+        assert route and route[0]["trace"] in blob
+
+    def test_load_dirs_stitches_colliding_ranks(self, tmp_path):
+        from tpuframe.track import telemetry as T
+        import tpuframe.track.analyze as A
+
+        dirs = []
+        for proc in range(2):  # two "processes", both rank 0
+            d = tmp_path / f"proc{proc}"
+            d.mkdir()
+            T.configure(jsonl_dir=str(d), rank=0)
+            try:
+                T.get_telemetry().event("fleet/markup",
+                                        replica=f"http://x:{proc}",
+                                        reason="probe")
+            finally:
+                T.reset()
+            dirs.append(str(d))
+        ranks = A.load_dirs(dirs)
+        assert [r.rank for r in ranks] == [0, 1000]
+        # and the merged stream builds one timeline
+        doc = A.build_trace(ranks)
+        assert json.dumps(doc).count("http://x:") >= 2
+
+    def test_baseline_gates_queue_wait_and_burn_rate(self, tmp_path):
+        import tpuframe.track.analyze as A
+
+        self._traced_run(tmp_path, n=6)
+        report = A.skew_report(A.load_dir(str(tmp_path)))
+        # force a nonzero current burn so the ratio is comparable
+        report["serve_trace"]["slo"]["burn_rate"] = 5.0
+        fast = tmp_path / "baseline_fast.json"
+        fast.write_text(json.dumps({
+            "backend": "cpu",
+            "serve_trace": {
+                "hops": {"queue_wait": {"p99": 1e-9}},
+                "slo": {"burn_rate": 1.0},
+            },
+        }))
+        diff = A.baseline_diff(report, str(fast), threshold=1.25,
+                               backend="cpu")
+        assert diff["regressions"]
+        entry = diff["regressions"][0]
+        assert entry["ratio_queue_wait_p99"] > 1.25
+        assert entry["ratio_burn_rate"] == pytest.approx(5.0)
+        text = A.format_report(report, diff)
+        assert "queue_wait_p99" in text and "burn_rate" in text
+        # an equal baseline does not regress
+        same = tmp_path / "baseline_same.json"
+        same.write_text(json.dumps({
+            "backend": "cpu",
+            "serve_trace": json.loads(json.dumps(report["serve_trace"])),
+        }))
+        ok = A.baseline_diff(report, str(same), threshold=1.25,
+                             backend="cpu")
+        assert not ok["regressions"]
+
+    def test_traceless_baseline_is_incomparable_not_regressed(self, tmp_path):
+        import tpuframe.track.analyze as A
+
+        self._traced_run(tmp_path, n=4)
+        report = A.skew_report(A.load_dir(str(tmp_path)))
+        bare = tmp_path / "baseline_bare.json"
+        bare.write_text(json.dumps({
+            "backend": "cpu",
+            "serve_latency": dict(report["serve_latency"]),
+        }))
+        diff = A.baseline_diff(report, str(bare), threshold=1.25,
+                               backend="cpu")
+        assert diff["baselines"], "serve_latency baseline must compare"
+        assert "ratio_queue_wait_p99" not in diff["baselines"][0]
+        assert "ratio_burn_rate" not in diff["baselines"][0]
+
+
+class TestTraceBenchRecord:
+    def test_committed_record_shape(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, os.pardir, "benchmarks", "results",
+                            "bench_serve_trace_cpu.json")
+        if not os.path.exists(path):
+            pytest.skip("trace bench record not committed yet")
+        with open(path) as f:
+            rec = json.load(f)
+        assert rec["metric"] == "serve_trace_request_path"
+        tr = rec["serve_trace"]
+        assert tr["traces"] > 0
+        for hop in ("route", "hop", "door", "queue_wait", "assemble",
+                    "infer", "respond"):
+            assert tr["hops"][hop]["count"] > 0, hop
+        assert rec["recompile_events"] == 0
+        ov = rec["trace_overhead"]
+        assert ov["untraced_p99_ms"] > 0 and ov["traced_p99_ms"] > 0
+        sample = rec["trace_sample"]
+        assert sample["trace"] and sample["hops"]
+
+    def test_committed_record_feeds_trace_gates(self, tmp_path):
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, os.pardir, "benchmarks", "results",
+                            "bench_serve_trace_cpu.json")
+        if not os.path.exists(path):
+            pytest.skip("trace bench record not committed yet")
+        import tpuframe.track.analyze as A
+
+        TestAnalyzeServeTrace()._traced_run(tmp_path, n=4)
+        report = A.skew_report(A.load_dir(str(tmp_path)))
+        diff = A.baseline_diff(report, path, backend="cpu")
+        assert diff["baselines"], "committed trace record not comparable"
+        assert diff["baselines"][0].get("ratio_queue_wait_p99") is not None

@@ -337,6 +337,40 @@ def fleet_section() -> dict:
     }
 
 
+def slo_section() -> dict:
+    """State of the serving SLO plane (``tpuframe.serve.slo``): the
+    declared objectives (strict env parse — a malformed
+    ``TPUFRAME_SLO_*`` is *reported*, not crashed on, mirroring the
+    health section's threshold idiom), the live burn-rate/error-budget
+    gauges off this process's registry, the ``TPUFRAME_SLO_*`` env
+    subset, and the paste-ready analyze one-liner whose ``serve_trace``
+    block scores a telemetry dir against the objectives that were in
+    force.  Stdlib-only, like the serve/fleet sections."""
+    import dataclasses
+
+    from tpuframe.serve.admission import SERVE_ENV_VARS
+    from tpuframe.serve.slo import SloObjectives
+    from tpuframe.track.telemetry import get_telemetry
+
+    try:
+        objectives = dataclasses.asdict(SloObjectives.from_env(strict=True))
+    except ValueError as e:
+        objectives = {"error": str(e)}
+    reg = get_telemetry().registry
+    return {
+        "objectives": objectives,
+        # live window state — 0.0 until something observes outcomes
+        "burn_rate": reg.gauge("slo/burn_rate").value,
+        "error_budget_remaining": reg.gauge("slo/error_budget").value,
+        "env": {
+            k: os.environ[k] for k in SERVE_ENV_VARS
+            if k.startswith("TPUFRAME_SLO_") and k in os.environ
+        },
+        "analyze": ("python -m tpuframe.track analyze "
+                    "$TPUFRAME_TELEMETRY_DIR --report"),
+    }
+
+
 def comms_section() -> dict:
     """State of the wire-compression spine
     (``tpuframe.parallel.compression``): the resolved compression config
@@ -565,6 +599,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "health": health_section(ckpt_dir),
         "serve": serve_section(export_path),
         "fleet": fleet_section(),
+        "slo": slo_section(),
         "comms": comms_section(),
         "profile": profile_section(),
         "autotune": autotune_section(devices),

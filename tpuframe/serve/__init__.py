@@ -17,6 +17,10 @@ Two halves:
   rolling checkpoint promotion (:meth:`ReplicaSet.promote`) gated on
   the checkpoint health stamp and a shadow-replica accuracy/latency
   check.  SERVE.md "Fleet" section is the runbook.
+- **SLO plane** (:class:`SloObjectives` / :class:`SloTracker`):
+  declared latency/availability objectives with rolling burn-rate and
+  error-budget gauges at both the replica and (fleet-aggregated) router
+  vantage points, scoring the per-hop request traces the router mints.
 
 Exports are lazy (PEP 562): the knob list / admission policy / artifact
 header reader stay importable while the jax backend is wedged — the
@@ -40,9 +44,12 @@ _LAZY = {
     "ServeKnobs": "tpuframe.serve.admission",
     "ServeResult": "tpuframe.serve.engine",
     "ServingServer": "tpuframe.serve.server",
+    "SloObjectives": "tpuframe.serve.slo",
+    "SloTracker": "tpuframe.serve.slo",
     "export_model": "tpuframe.serve.export",
     "load_model": "tpuframe.serve.export",
     "read_export_meta": "tpuframe.serve.admission",
+    "sanitize_trace_id": "tpuframe.serve.admission",
     "validate_payload": "tpuframe.serve.admission",
 }
 

@@ -54,8 +54,28 @@ __all__ = [
     "RequestShed",
     "ServeKnobs",
     "read_export_meta",
+    "sanitize_trace_id",
     "validate_payload",
 ]
+
+#: charset a request-path trace id may use — the id is echoed into
+#: telemetry JSONL and response headers, so a hostile ``X-Trace-Id``
+#: must not smuggle newlines/control bytes through the front door
+_TRACE_ID_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_."
+)
+
+
+def sanitize_trace_id(raw: Any) -> str | None:
+    """A usable trace id (bounded length, safe charset) or None — the
+    door check both :class:`~tpuframe.serve.server.ServingServer` and
+    the fleet router apply to a client-supplied ``X-Trace-Id``."""
+    if not isinstance(raw, str):
+        return None
+    raw = raw.strip()
+    if 0 < len(raw) <= 64 and all(c in _TRACE_ID_CHARS for c in raw):
+        return raw
+    return None
 
 #: every env knob the serving spine reads — THE list, consumed by
 #: ``launch.remote.all_env_vars()`` (shipped to every host) and by the
@@ -76,6 +96,9 @@ SERVE_ENV_VARS = (
     "TPUFRAME_FLEET_REPLICAS",
     "TPUFRAME_FLEET_SHADOW_REQUESTS",
     "TPUFRAME_FLEET_GATE_AGREEMENT",
+    # SLO plane (read by serve.slo.SloObjectives.from_env)
+    "TPUFRAME_SLO_P99_MS",
+    "TPUFRAME_SLO_AVAILABILITY",
 )
 
 #: value domains for the knobs above (KN007).  ``apply``: buckets /
@@ -112,6 +135,11 @@ SERVE_ENV_DOMAINS = {
         "type": "int", "range": (1, None), "apply": "restart"},
     "TPUFRAME_FLEET_GATE_AGREEMENT": {
         "type": "float", "range": (0, 1.0), "apply": "restart"},
+    # SLO objectives are read per tracker construction -> live
+    "TPUFRAME_SLO_P99_MS": {
+        "type": "float", "range": (1.0, None), "apply": "live"},
+    "TPUFRAME_SLO_AVAILABILITY": {
+        "type": "float", "range": (0, 1.0), "apply": "live"},
 }
 
 #: pixel budget default — PIL's ``MAX_IMAGE_PIXELS`` (the same ceiling

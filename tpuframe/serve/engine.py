@@ -44,6 +44,15 @@ invalid/error counters, one ``serve/request`` event per served request
 rate-limited ``serve/rejected``/``serve/shed`` event stream (first
 occurrence per verdict always logs; steady-state overload counts
 instead of flooding the JSONL log).
+
+Request-path tracing: a request submitted with a ``trace`` id (the
+router mints one; clients can supply ``X-Trace-Id``) gets per-hop spans
+— ``serve/door`` (validation), ``serve/queue_wait`` (submit to batch
+assembly), ``serve/assemble``/``serve/infer`` (batch-scoped, fanned out
+to every member trace) — that ``track analyze`` stitches into the
+``serve_trace`` block and the Perfetto timeline.  Untraced requests pay
+nothing.  Every outcome also feeds the :class:`~tpuframe.serve.slo.
+SloTracker` burn-rate/error-budget gauges.
 """
 
 from __future__ import annotations
@@ -67,6 +76,7 @@ from tpuframe.serve.admission import (
     ServeKnobs,
     validate_payload,
 )
+from tpuframe.serve.slo import SloTracker
 from tpuframe.track.telemetry import get_telemetry
 
 __all__ = ["ServeEngine", "ServeResult"]
@@ -112,15 +122,20 @@ class ServeResult:
 
 
 class _Request:
-    __slots__ = ("payload", "res", "t_submit", "deadline", "synthetic")
+    __slots__ = ("payload", "res", "t_submit", "deadline", "synthetic",
+                 "trace")
 
     def __init__(self, payload, res: ServeResult | None, t_submit: float,
-                 deadline: float, synthetic: bool = False):
+                 deadline: float, synthetic: bool = False,
+                 trace: str | None = None):
         self.payload = payload
         self.res = res
         self.t_submit = t_submit
         self.deadline = deadline
         self.synthetic = synthetic
+        # request-path trace id (router-minted or client-supplied);
+        # None means untraced — the hot path emits nothing extra
+        self.trace = trace
 
 
 class _RateLimitedEvents:
@@ -182,6 +197,9 @@ class ServeEngine:
         # so the analyzer can break serve_latency out per replica
         self.replica = replica
         meta = getattr(model, "meta", None)
+        # model identity for the analyzer's per-model trace breakout
+        self.model_name = (meta.get("model") if isinstance(meta, dict)
+                           else None)
         if item_shape is None and isinstance(meta, dict):
             item_shape = tuple(meta["input_shape"][1:])
         if dtype is None and isinstance(meta, dict):
@@ -235,6 +253,10 @@ class ServeEngine:
         self._h_latency = reg.histogram("serve/latency")
         self._h_occupancy = reg.histogram("serve/batch_occupancy")
         self._g_draining = reg.gauge("serve/draining")
+        # SLO plane: every outcome (served/shed/rejected) feeds the
+        # rolling burn-rate/error-budget gauges on this replica's
+        # /metrics page; the router keeps the fleet-wide aggregate
+        self._slo = SloTracker(source="engine")
         # observed request-batch sizes (bounded; batcher thread appends,
         # the autotuner reads a snapshot) — the empirical distribution
         # tpuframe.autotune.derive_serve_knobs turns into a bucket set
@@ -350,7 +372,8 @@ class ServeEngine:
         return {"applied": applied, "restart_only": restart_only}
 
     # -- door ----------------------------------------------------------------
-    def submit(self, x: Any, *, deadline_ms: float | None = None) -> ServeResult:
+    def submit(self, x: Any, *, deadline_ms: float | None = None,
+               trace: str | None = None) -> ServeResult:
         """Validate, admit, and enqueue one request.
 
         Raises :class:`InvalidRequest` (malformed/poison payload) or
@@ -360,6 +383,11 @@ class ServeEngine:
         row of the model output.  Under ``shed-oldest`` an admission may
         evict the oldest queued request — *that* request's future fails
         with :class:`RequestShed`.
+
+        ``trace``: request-path trace id (router-minted or client
+        ``X-Trace-Id``).  When set, the door validation and every
+        downstream hop emit spans tagged with it; when None the request
+        path pays nothing extra.
         """
         if not self._started:
             raise RuntimeError("ServeEngine.start() first")
@@ -369,13 +397,17 @@ class ServeEngine:
         # poison injection point: upstream of validation, exactly where
         # a corrupt client payload would enter
         chaos.maybe_fire("serve/submit", step, payload=x, engine=self)
+        door = (tele.span("serve/door", trace=trace)
+                if trace is not None else contextlib.nullcontext())
         try:
-            validate_payload(
-                x, item_shape=self.item_shape, dtype=self.dtype,
-                max_pixels=self.knobs.max_pixels,
-            )
+            with door:
+                validate_payload(
+                    x, item_shape=self.item_shape, dtype=self.dtype,
+                    max_pixels=self.knobs.max_pixels,
+                )
         except InvalidRequest as e:
             self._c_invalid.inc()
+            self._slo.observe(ok=False)
             self._limited.emit(
                 tele, "serve/rejected", verdict="invalid", error=str(e)[:300]
             )
@@ -385,12 +417,13 @@ class ServeEngine:
         slo_s = (self.knobs.slo_ms if deadline_ms is None
                  else float(deadline_ms)) / 1e3
         res = ServeResult(next(self._rid))
-        req = _Request(x, res, now, now + slo_s)
+        req = _Request(x, res, now, now + slo_s, trace=trace)
         verdict, shed = self._admission.offer(req)
         if shed is not None:
             self._shed(shed, "shed-oldest")
         if verdict != "admitted":
             self._c_rejected.inc()
+            self._slo.observe(ok=False)
             self._limited.emit(tele, "serve/rejected", verdict=verdict)
             raise RequestRejected(
                 f"request rejected: {verdict} (queue_cap="
@@ -469,6 +502,7 @@ class ServeEngine:
     # -- internals -----------------------------------------------------------
     def _shed(self, req: _Request, verdict: str) -> None:
         self._c_shed.inc()
+        self._slo.observe(ok=False)
         self._limited.emit(get_telemetry(), "serve/shed", verdict=verdict)
         if req.res is not None:
             req.res._fail(
@@ -538,24 +572,46 @@ class ServeEngine:
             self._batches += 1
             n = len(batch)
             bucket = next(b for b in self.buckets if b >= n)
+            # per-hop attribution for traced members: queue wait ends
+            # when this batch starts assembling, so queue_wait + assemble
+            # + infer tiles the engine-side request path with no gaps
+            traces = [r.trace for r in batch if r.trace is not None]
+            if traces:
+                t_asm = time.monotonic()
+                for r in batch:
+                    if r.trace is not None:
+                        tele.event(
+                            "serve/queue_wait", kind="span",
+                            dur_s=round(max(0.0, t_asm - r.t_submit), 6),
+                            trace=r.trace, batch=bidx,
+                        )
             try:
                 chaos.maybe_fire("serve/batch", bidx, n=n, bucket=bucket,
                                  engine=self)
-                pool = self._pools[bucket]
-                lease = pool.acquire(bucket, self.item_shape, self.dtype,
-                                     with_valid=False)
-                for i, r in enumerate(batch):
-                    np.copyto(lease.images[i], r.payload, casting="same_kind")
-                for i in range(n, bucket):  # pad by cycling live payloads
-                    np.copyto(lease.images[i], batch[i % n].payload,
-                              casting="same_kind")
-                sig = batch_signature({"image": lease.images})
-                self._guard.check("serve", sig)
+                # batch-scoped spans carry the member trace ids so the
+                # analyzer can fan one assemble/infer out to every
+                # request that rode the batch
+                asm = (tele.span("serve/assemble", batch=bidx, n=n,
+                                 traces=traces)
+                       if traces else contextlib.nullcontext())
+                with asm:
+                    pool = self._pools[bucket]
+                    lease = pool.acquire(bucket, self.item_shape, self.dtype,
+                                         with_valid=False)
+                    for i, r in enumerate(batch):
+                        np.copyto(lease.images[i], r.payload,
+                                  casting="same_kind")
+                    for i in range(n, bucket):  # pad by cycling live payloads
+                        np.copyto(lease.images[i], batch[i % n].payload,
+                                  casting="same_kind")
+                    sig = batch_signature({"image": lease.images})
+                    self._guard.check("serve", sig)
                 # watchdog_s=0 means DISABLED, including any process-wide
                 # default deadline — passing None would fall back to it
                 wd = (tele.guard("serve/infer", self.knobs.watchdog_s)
                       if self.knobs.watchdog_s > 0 else contextlib.nullcontext())
-                with tele.span("serve/infer", batch=bidx, bucket=bucket, n=n), \
+                with tele.span("serve/infer", batch=bidx, bucket=bucket, n=n,
+                               **({"traces": traces} if traces else {})), \
                         wd:
                     chaos.maybe_fire("serve/infer", bidx, engine=self)
                     xd = jax.device_put(lease.images)
@@ -568,6 +624,7 @@ class ServeEngine:
                 tele.event("serve/batch_error", batch=bidx,
                            error=f"{type(e).__name__}: {e}"[:300])
                 for r in batch:
+                    self._slo.observe(ok=False)
                     if r.res is not None:
                         r.res._fail(e, "error")
                 continue
@@ -579,10 +636,15 @@ class ServeEngine:
                 lat = done - r.t_submit
                 self._h_latency.observe(lat)
                 self._c_served.inc()
+                self._slo.observe(lat)
                 tele.event("serve/request", latency_s=round(lat, 6),
                            batch=bidx, verdict="ok",
                            **({"replica": self.replica}
                               if self.replica is not None else {}),
+                           **({"trace": r.trace}
+                              if r.trace is not None else {}),
+                           **({"model": self.model_name}
+                              if self.model_name else {}),
                            **({"synthetic": True} if r.synthetic else {}))
                 if r.res is not None:
                     r.res._complete(out[i], "ok", lat)

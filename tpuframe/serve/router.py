@@ -31,6 +31,17 @@ The router also keeps a small ring of recent request bodies —
 :meth:`tpuframe.serve.fleet.ReplicaSet.promote` replays through a shadow
 replica's accuracy/latency gate.
 
+Observability (this is the fleet's one front door, so it narrates):
+every ``/predict`` is traced — the router mints a trace id (or honors a
+sane client ``X-Trace-Id``), forwards it, and emits one ``fleet/route``
+span plus a ``fleet/hop`` span per forward attempt; mark-down/mark-up
+transitions emit ``fleet/markdown``/``fleet/markup`` events (replica +
+reason) and bump the ``fleet/markdowns`` counter; a fleet-wide
+:class:`~tpuframe.serve.slo.SloTracker` scores every routed reply so
+the router's ``/metrics`` burn-rate gauge is the aggregate SLO signal;
+and ``/metrics`` appends per-replica ``replica``-labeled gauge lines so
+one scrape covers the fleet.
+
 Stdlib-only (urllib + http.server + threading), like the server it
 fronts: the fleet's front door must keep routing while the jax backend
 of any one replica is wedged.
@@ -48,8 +59,11 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 
 from tpuframe.fault.health import _env_float, _env_int
+from tpuframe.serve.admission import sanitize_trace_id
+from tpuframe.serve.slo import SloTracker
 from tpuframe.track.telemetry import get_telemetry
 
 __all__ = ["FleetKnobs", "Router"]
@@ -159,8 +173,13 @@ class Router:
         self._c_requests = reg.counter("fleet/requests")
         self._c_retries = reg.counter("fleet/retries")
         self._c_no_backend = reg.counter("fleet/no_backend")
+        self._c_markdowns = reg.counter("fleet/markdowns")
         self._g_healthy = reg.gauge("fleet/healthy_replicas")
         self._g_size = reg.gauge("fleet/size")
+        # fleet-wide SLO aggregate: every routed reply is one outcome,
+        # so the router's /metrics burn-rate gauge answers "is the
+        # fleet inside its SLO" in one scrape
+        self._slo = SloTracker(source="router")
         self._server = None
         self.host = host
         self._requested_port = port
@@ -250,8 +269,11 @@ class Router:
                 b.fails = 0 if healthy else b.fails + 1
             if healthy and not was:
                 tele.event("fleet/replica_up", url=b.url)
+                tele.event("fleet/markup", replica=b.url, reason="probe")
             elif was and not healthy:
                 tele.event("fleet/replica_down", url=b.url, via="probe")
+                self._c_markdowns.inc()
+                tele.event("fleet/markdown", replica=b.url, reason="probe")
         with self._lock:
             self._g_healthy.set(
                 float(sum(1 for x in self._backends.values() if x.healthy))
@@ -273,7 +295,38 @@ class Router:
             self._g_healthy.set(
                 float(sum(1 for x in self._backends.values() if x.healthy))
             )
-        get_telemetry().event("fleet/replica_down", url=url, via=reason)
+        tele = get_telemetry()
+        tele.event("fleet/replica_down", url=url, via=reason)
+        self._c_markdowns.inc()
+        tele.event("fleet/markdown", replica=url, reason=reason)
+
+    def _fleet_metrics_text(self) -> str:
+        """Per-replica gauge lines with a ``replica`` label, appended to
+        the router's own Prometheus page — one scrape of the router
+        returns the whole fleet's load/health view (from probe state; no
+        per-replica fan-out on the scrape path).  The labeled
+        ``tpuframe_serve_queue_depth`` lines never collide with the
+        unlabeled gauge a replica's own page serves, and never match the
+        ``_scrape_queue_depth`` fallback (which requires the unlabeled
+        form), so a router is safe to scrape as if it were a replica."""
+        with self._lock:
+            reps = [(b.url, b.healthy, b.draining, b.queue_depth, b.ewma_s)
+                    for b in self._backends.values()]
+        lines = []
+        for url, healthy, draining, depth, ewma in reps:
+            label = '{replica="' + url + '"}'
+            lines.append(f"tpuframe_serve_queue_depth{label} {int(depth)}")
+            lines.append(
+                f"tpuframe_fleet_replica_healthy{label} {int(healthy)}"
+            )
+            lines.append(
+                f"tpuframe_fleet_replica_draining{label} {int(draining)}"
+            )
+            lines.append(
+                f"tpuframe_fleet_replica_ewma_seconds{label} "
+                f"{round(ewma, 6)}"
+            )
+        return "".join(line + "\n" for line in lines)
 
     # -- request path --------------------------------------------------------
     def _pick(self, exclude: set[str]) -> str | None:
@@ -312,11 +365,33 @@ class Router:
                 b.ewma_s = 0.8 * b.ewma_s + 0.2 * dt
         return code, out, hdrs
 
-    def handle_predict(self, body: bytes,
-                       headers: dict) -> tuple[int, bytes, dict]:
+    def handle_predict(self, body: bytes, headers: dict,
+                       trace: str | None = None) -> tuple[int, bytes, dict]:
         """Route one request: least-loaded replica, bounded budgeted
         retry-on-other for connection-refused/5xx/429.  Returns
-        ``(status, body, relay_headers)``."""
+        ``(status, body, relay_headers)``.
+
+        ``trace``: request-path trace id.  When set, the routing pass
+        emits one ``fleet/route`` span (total router time, final status,
+        attempt count) plus one ``fleet/hop`` span per forward attempt,
+        and the id is echoed on the relay headers.
+        """
+        t0 = time.monotonic()
+        code, out, relay = self._route(body, headers, trace)
+        dt = time.monotonic() - t0
+        # fleet-wide SLO outcome: what the client saw at the front door
+        self._slo.observe(dt, ok=code < 400)
+        if trace is not None:
+            get_telemetry().event(
+                "fleet/route", kind="span", dur_s=round(dt, 6),
+                trace=trace, status=code,
+            )
+            relay = {**relay, "X-Trace-Id": trace}
+        return code, out, relay
+
+    def _route(self, body: bytes, headers: dict,
+               trace: str | None) -> tuple[int, bytes, dict]:
+        tele = get_telemetry()
         self._c_requests.inc()
         with self._lock:
             self._mirror.append(body)
@@ -328,14 +403,29 @@ class Router:
             if url is None:
                 break
             tried.add(url)
+            t_hop = time.monotonic()
             try:
                 code, out, hdrs = self._forward(
                     url, body, headers, self.request_timeout_s
                 )
             except Exception as e:  # refused/reset/timeout: replica is gone
+                if trace is not None:
+                    tele.event(
+                        "fleet/hop", kind="span",
+                        dur_s=round(time.monotonic() - t_hop, 6),
+                        trace=trace, replica=url, attempt=attempts,
+                        status=0, error=type(e).__name__,
+                    )
                 self._mark_down(url, f"forward:{type(e).__name__}")
                 last = None
             else:
+                if trace is not None:
+                    tele.event(
+                        "fleet/hop", kind="span",
+                        dur_s=round(time.monotonic() - t_hop, 6),
+                        trace=trace, replica=url, attempt=attempts,
+                        status=code,
+                    )
                 relay = {"X-Fleet-Replica": url}
                 if "Retry-After" in hdrs:
                     relay["Retry-After"] = hdrs["Retry-After"]
@@ -353,7 +443,7 @@ class Router:
         if last is not None:
             return last  # relay the backend's own verdict (shed, not storm)
         self._c_no_backend.inc()
-        get_telemetry().event(
+        tele.event(
             "fleet/no_backend", tried=len(tried),
             healthy=len(self.healthy_backends()),
         )
@@ -399,10 +489,15 @@ class Router:
                         "status": "ok",
                         "replicas": reps,
                         "healthy": sum(1 for r in reps if r["healthy"]),
+                        # green = actually routable (healthy AND not
+                        # draining) — what a supervisor should alert on
+                        "green": sum(1 for r in reps
+                                     if r["healthy"] and not r["draining"]),
                     }).encode()
                     self._send(200, body, {})
                 elif path == "/metrics":
-                    body = registry.prometheus_text().encode()
+                    body = (registry.prometheus_text()
+                            + router_self._fleet_metrics_text()).encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
@@ -424,7 +519,16 @@ class Router:
                 deadline = self.headers.get("X-Deadline-Ms")
                 if deadline:
                     fwd["X-Deadline-Ms"] = deadline
-                code, out, hdrs = router_self.handle_predict(body, fwd)
+                # trace mint: honor a sane client X-Trace-Id, else mint —
+                # every request routed through the fleet front door is
+                # traced end to end
+                trace = sanitize_trace_id(self.headers.get("X-Trace-Id"))
+                if trace is None:
+                    trace = uuid.uuid4().hex[:16]
+                fwd["X-Trace-Id"] = trace
+                code, out, hdrs = router_self.handle_predict(
+                    body, fwd, trace=trace
+                )
                 self._send(code, out, hdrs)
 
             def log_message(self, *args):  # requests must not spam stderr

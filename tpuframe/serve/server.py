@@ -6,7 +6,10 @@ a serving box).  Endpoints:
 
 - ``POST /predict`` — body is an ``.npy`` blob (``np.save`` of one
   request payload; content-type anything).  Optional header
-  ``X-Deadline-Ms`` propagates the client deadline into scheduling.
+  ``X-Deadline-Ms`` propagates the client deadline into scheduling;
+  optional ``X-Trace-Id`` (router-minted or client-supplied, sanitized
+  at the door) arms per-hop request tracing through the engine and is
+  echoed back on the response.
   Responses carry the admission verdict as an HTTP status: 200 served
   (JSON ``{"output": [...], "latency_ms": ...}``), 400 invalid payload,
   429 shed/rejected under load (clients should back off), 503 draining
@@ -34,7 +37,12 @@ import json
 import threading
 from typing import Any
 
-from tpuframe.serve.admission import InvalidRequest, RequestRejected, RequestShed
+from tpuframe.serve.admission import (
+    InvalidRequest,
+    RequestRejected,
+    RequestShed,
+    sanitize_trace_id,
+)
 
 __all__ = ["ServingServer"]
 
@@ -57,7 +65,8 @@ class ServingServer:
         # one request payload, exactly: item bytes + .npy header slack
         item = np.zeros(engine.item_shape, engine.dtype)
         self.max_body_bytes = int(item.nbytes) + 4096
-        registry = get_telemetry().registry
+        tele = get_telemetry()
+        registry = tele.registry
         server_self = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -122,28 +131,45 @@ class ServingServer:
                     deadline_ms = float(deadline) if deadline else None
                 except ValueError:
                     deadline_ms = None
+                trace = sanitize_trace_id(self.headers.get("X-Trace-Id"))
+                # only pass trace= when a trace id actually arrived:
+                # duck-typed engines (tests, wrappers) predating the
+                # kwarg keep working, and the untraced path is identical
+                # to before
+                kw = {"deadline_ms": deadline_ms}
+                if trace is not None:
+                    kw["trace"] = trace
+                thdrs = {"X-Trace-Id": trace} if trace is not None else None
                 try:
-                    res = server_self.engine.submit(
-                        payload, deadline_ms=deadline_ms
-                    )
+                    res = server_self.engine.submit(payload, **kw)
                     out = res.result(timeout=server_self.result_timeout_s)
                 except InvalidRequest as e:
-                    self._send(400, {"error": str(e), "verdict": "invalid"})
+                    self._send(400, {"error": str(e), "verdict": "invalid"},
+                               headers=thdrs)
                 except RequestRejected as e:
                     code = 503 if e.verdict == "rejected-draining" else 429
                     self._send(code, {"error": str(e), "verdict": e.verdict},
-                               headers=server_self._retry_after())
+                               headers={**server_self._retry_after(),
+                                        **(thdrs or {})})
                 except RequestShed as e:
                     self._send(429, {"error": str(e), "verdict": e.verdict},
-                               headers=server_self._retry_after())
+                               headers={**server_self._retry_after(),
+                                        **(thdrs or {})})
                 except TimeoutError as e:
-                    self._send(504, {"error": str(e), "verdict": "timeout"})
+                    self._send(504, {"error": str(e), "verdict": "timeout"},
+                               headers=thdrs)
                 else:
-                    self._send(200, {
+                    doc = {
                         "output": np.asarray(out).tolist(),
                         "latency_ms": round((res.latency_s or 0.0) * 1e3, 3),
                         "verdict": res.verdict,
-                    })
+                    }
+                    if trace is not None:
+                        # the final hop: serialization + socket write
+                        with tele.span("serve/respond", trace=trace):
+                            self._send(200, doc, headers=thdrs)
+                    else:
+                        self._send(200, doc)
 
             def log_message(self, *args):  # requests must not spam stderr
                 pass

@@ -1,0 +1,188 @@
+"""The serving SLO plane: declared objectives + rolling burn-rate gauges.
+
+An SLO is a *declared* contract — "p99 under ``TPUFRAME_SLO_P99_MS``,
+availability at least ``TPUFRAME_SLO_AVAILABILITY``" — and the fleet's
+health is how fast it is spending the error budget that contract allows,
+not a raw error count.  :class:`SloTracker` keeps a rolling window of
+request outcomes and exports two gauges on the existing telemetry spine
+(so they ride every ``/metrics`` page for free):
+
+- ``slo/burn_rate`` — the rate the error budget is being consumed,
+  normalized so 1.0 means "burning exactly the allowed budget" (a
+  violation fraction of ``1 - availability``).  >1 is an incident
+  brewing; sustained >>1 is the page.
+- ``slo/error_budget`` — the remaining budget fraction over the window,
+  ``max(0, 1 - burn_rate)``.
+
+A request is *bad* when it failed (shed/rejected/errored) or when it
+was served over the p99 objective — latency violations spend the same
+budget as errors, which is what makes the burn rate a routing/promotion
+signal rather than an uptime vanity metric.
+
+Every tracker announces its contract as one ``slo/objectives`` event at
+construction, so ``track analyze`` can score a telemetry dir against the
+objectives that were actually in force (``skew_report.serve_trace.slo``)
+instead of whatever env the analyzing host happens to have.
+
+Deployed at both ends of the request path: each :class:`ServeEngine`
+tracks its own served/shed outcomes, and the fleet :class:`Router`
+tracks every routed request — the router's gauges are therefore the
+fleet-wide aggregate (one scrape of the router ``/metrics`` answers "is
+the fleet inside its SLO", no per-replica fan-out).
+
+Stdlib-only, like the admission/router layer it instruments.
+"""
+
+# tpuframe-lint: stdlib-only
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+
+from tpuframe.fault.health import _env_float
+from tpuframe.track.telemetry import get_telemetry
+
+__all__ = ["SloObjectives", "SloTracker"]
+
+
+def _strict_float(name: str, default: float) -> float:
+    """Env float that *raises* on garbage — the doctor's strict read, so
+    a malformed ``TPUFRAME_SLO_*`` is reported instead of silently
+    replaced by the default the tolerant path would use."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjectives:
+    """The declared serving objectives (env-tunable, live-apply).
+
+    Attributes:
+      p99_ms: served-latency objective — a request slower than this is
+        an SLO violation even though the client got an answer.
+      availability: minimum good-request fraction; ``1 - availability``
+        is the error budget the burn rate is normalized against.
+    """
+
+    p99_ms: float = 500.0
+    availability: float = 0.999
+
+    @classmethod
+    def from_env(cls, *, strict: bool = False) -> "SloObjectives":
+        """Tolerant by default (malformed/out-of-range env reads as the
+        default — a typo'd objective must not take a serving box down);
+        ``strict=True`` raises ``ValueError`` instead, for the doctor's
+        report-don't-crash idiom."""
+        d = cls()
+        if strict:
+            p99_ms = _strict_float("TPUFRAME_SLO_P99_MS", d.p99_ms)
+            availability = _strict_float(
+                "TPUFRAME_SLO_AVAILABILITY", d.availability
+            )
+            if not p99_ms >= 1.0:
+                raise ValueError(
+                    f"TPUFRAME_SLO_P99_MS={p99_ms} must be >= 1.0"
+                )
+            if not 0.0 < availability <= 1.0:
+                raise ValueError(
+                    f"TPUFRAME_SLO_AVAILABILITY={availability} must be in "
+                    "(0, 1]"
+                )
+            return cls(p99_ms=p99_ms, availability=availability)
+        p99_ms = _env_float("TPUFRAME_SLO_P99_MS", d.p99_ms)
+        availability = _env_float("TPUFRAME_SLO_AVAILABILITY", d.availability)
+        if not p99_ms >= 1.0:
+            p99_ms = d.p99_ms
+        if not 0.0 < availability <= 1.0:
+            availability = d.availability
+        return cls(p99_ms=p99_ms, availability=availability)
+
+
+class SloTracker:
+    """Rolling-window burn-rate/error-budget gauges for one vantage point.
+
+    ``observe()`` is called once per request outcome (engine: served /
+    shed / rejected; router: every routed reply) and is cheap enough for
+    the hot path — one deque append + two gauge stores under a lock.
+    """
+
+    def __init__(self, objectives: SloObjectives | None = None, *,
+                 window_s: float = 60.0, source: str | None = None):
+        self.objectives = objectives or SloObjectives.from_env()
+        self.window_s = float(window_s)
+        self._samples: collections.deque = collections.deque()  # (mono, bad)
+        self._bad = 0
+        self._lock = threading.Lock()
+        tele = get_telemetry()
+        self._g_burn = tele.registry.gauge("slo/burn_rate")
+        self._g_budget = tele.registry.gauge("slo/error_budget")
+        # announce the contract in force — the analyzer scores the dir
+        # against this record, not the analyzing host's env
+        tele.event(
+            "slo/objectives",
+            p99_ms=self.objectives.p99_ms,
+            availability=self.objectives.availability,
+            window_s=self.window_s,
+            **({"source": source} if source else {}),
+        )
+
+    def observe(self, latency_s: float | None = None, *,
+                ok: bool = True) -> None:
+        """Record one request outcome: ``ok=False`` for shed/rejected/
+        errored, otherwise bad iff the served latency broke the p99
+        objective."""
+        bad = (not ok) or (
+            latency_s is not None
+            and latency_s * 1e3 > self.objectives.p99_ms
+        )
+        now = time.monotonic()
+        with self._lock:
+            self._samples.append((now, bad))
+            if bad:
+                self._bad += 1
+            self._evict_locked(now)
+            burn, budget = self._rates_locked()
+        self._g_burn.set(burn)
+        self._g_budget.set(budget)
+
+    def _evict_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._samples and self._samples[0][0] < horizon:
+            _, bad = self._samples.popleft()
+            if bad:
+                self._bad -= 1
+
+    def _rates_locked(self) -> tuple[float, float]:
+        total = len(self._samples)
+        if total == 0:
+            return 0.0, 1.0
+        allowed = max(1e-9, 1.0 - self.objectives.availability)
+        burn = (self._bad / total) / allowed
+        return burn, max(0.0, 1.0 - burn)
+
+    def snapshot(self) -> dict:
+        """Current window state (doctor/tests): objectives + counts +
+        the two gauge values."""
+        with self._lock:
+            self._evict_locked(time.monotonic())
+            total = len(self._samples)
+            bad = self._bad
+            burn, budget = self._rates_locked()
+        return {
+            "p99_ms": self.objectives.p99_ms,
+            "availability": self.objectives.availability,
+            "window_s": self.window_s,
+            "requests": total,
+            "violations": bad,
+            "burn_rate": round(burn, 4),
+            "error_budget_remaining": round(budget, 4),
+        }

@@ -70,6 +70,7 @@ __all__ = [
     "fleet_degraded",
     "format_report",
     "load_dir",
+    "load_dirs",
     "load_rank",
     "main",
     "reset_fleet_degraded",
@@ -209,6 +210,32 @@ def load_dir(d: str) -> list[RankLog]:
     ranks = [load_rank(b) for b in bases]
     ranks.sort(key=lambda r: r.rank)
     return ranks
+
+
+def load_dirs(dirs: Sequence[str]) -> list[RankLog]:
+    """Multiple telemetry dirs stitched into one rank list.
+
+    The multi-process serve topology (router + N replica servers, each
+    its own process with its own ``TPUFRAME_TELEMETRY_DIR``) logs rank 0
+    in every dir; loading them together must not collapse those onto one
+    Perfetto track.  Colliding rank numbers from later dirs are offset
+    by +1000 per collision — each process keeps its own pid lane — while
+    the per-pid wall/mono anchors (which travel inside each log) do the
+    cross-process time alignment, so one trace id lines up across all of
+    them.  A single dir loads exactly like :func:`load_dir`.
+    """
+    all_ranks: list[RankLog] = []
+    used: set[int] = set()
+    for d in dirs:
+        for rl in load_dir(d):
+            r = rl.rank
+            while r in used:
+                r += 1000
+            rl.rank = r
+            used.add(r)
+            all_ranks.append(rl)
+    all_ranks.sort(key=lambda r: r.rank)
+    return all_ranks
 
 
 # -- Perfetto / Chrome trace --------------------------------------------------
@@ -524,22 +551,202 @@ def _time_to_first_step(rl: RankLog) -> float | None:
     return max(0.0, first_step - t0)
 
 
+# -- request-path trace attribution -------------------------------------------
+
+#: serve_trace block schema (versioned like device_time: additive ->
+#: minor bump, rename/removal -> major bump + consumer update)
+SERVE_TRACE_VERSION = "1.0"
+
+#: span name -> hop key, in request-path order.  fleet/route and
+#: fleet/hop come from the router (route = total front-door time, hop =
+#: one forward attempt); door/queue_wait are per-request engine spans;
+#: assemble/infer are batch-scoped (a ``traces`` list fans the one span
+#: out to every member request); respond is the server's response write.
+_TRACE_HOP_SPANS = {
+    "fleet/route": "route",
+    "fleet/hop": "hop",
+    "serve/door": "door",
+    "serve/queue_wait": "queue_wait",
+    "serve/assemble": "assemble",
+    "serve/infer": "infer",
+    "serve/respond": "respond",
+}
+
+_TRACE_HOP_ORDER = (
+    "route", "hop", "door", "queue_wait", "assemble", "infer", "respond",
+)
+
+
+def _span_field(rec: dict, key: str) -> Any:
+    """A span attribute wherever it lives: ``tele.span`` nests kwargs in
+    the ``attrs`` sub-dict, synthetic span records (``tele.event(...,
+    kind="span")`` — cross-thread hops whose outcome is only known after
+    the fact) carry them top-level."""
+    v = rec.get(key)
+    if v is None:
+        v = (rec.get("attrs") or {}).get(key)
+    return v
+
+
+def _quantile_block(vals: list[float]) -> dict:
+    vals = sorted(vals)
+    return {
+        "count": len(vals),
+        "p50": round(_pctl(vals, 0.50), 6),
+        "p95": round(_pctl(vals, 0.95), 6),
+        "p99": round(_pctl(vals, 0.99), 6),
+    }
+
+
+def _serve_trace_info(ranks: Sequence[RankLog]) -> dict | None:
+    """Per-hop request-path attribution from the trace-tagged spans the
+    router/server/engine emit; None when the run traced nothing.
+
+    Durations accumulate **per trace id** first (a retried request's two
+    ``fleet/hop`` spans sum; a batch-scoped ``serve/infer`` charges its
+    full duration to every member trace — the batch is the unit of
+    device work each rider waits for), then quantile per hop, so the
+    hop p50/p95/p99 are distributions over *requests*, comparable with
+    the end-to-end latency distribution: ``queue_wait + assemble +
+    infer`` tiles the engine-side path, and e2e minus the hop sum is
+    unattributed transport/scheduling time.
+    """
+    per_trace: dict[str, dict[str, float]] = {}
+    route_spans = 0
+    hop_spans = 0
+    objectives: dict | None = None
+    for rl in ranks:
+        for rec in rl.events:
+            if rec.get("name") == "slo/objectives":
+                objectives = rec
+                continue
+            if rec.get("kind") != "span":
+                continue
+            hop = _TRACE_HOP_SPANS.get(rec.get("name"))
+            if hop is None:
+                continue
+            try:
+                dur = float(rec.get("dur_s", 0.0))
+            except (TypeError, ValueError):
+                continue
+            traces = _span_field(rec, "traces")
+            if not isinstance(traces, (list, tuple)):
+                t = _span_field(rec, "trace")
+                traces = [t] if t is not None else []
+            if not traces:
+                continue
+            if hop == "route":
+                route_spans += 1
+            elif hop == "hop":
+                hop_spans += 1
+            for t in traces:
+                hops = per_trace.setdefault(str(t), {})
+                hops[hop] = hops.get(hop, 0.0) + dur
+    if not per_trace:
+        return None
+
+    # end-to-end + breakouts from the serve/request events that carry a
+    # trace id (engine-side served latency, replica/model tagged)
+    e2e: dict[str, float] = {}
+    by_replica: dict[str, list[float]] = {}
+    by_model: dict[str, list[float]] = {}
+    all_lats: list[float] = []
+    for rl in ranks:
+        for rec in rl.events:
+            if rec.get("name") != "serve/request":
+                continue
+            lat = rec.get("latency_s")
+            if not isinstance(lat, (int, float)):
+                continue
+            all_lats.append(float(lat))
+            t = rec.get("trace")
+            if t is None:
+                continue
+            e2e[str(t)] = float(lat)
+            rep = rec.get("replica")
+            if rep is not None:
+                by_replica.setdefault(str(rep), []).append(float(lat))
+            mdl = rec.get("model")
+            if mdl is not None:
+                by_model.setdefault(str(mdl), []).append(float(lat))
+
+    hops_block = {
+        hop: _quantile_block(
+            [v[hop] for v in per_trace.values() if hop in v]
+        )
+        for hop in _TRACE_HOP_ORDER
+        if any(hop in v for v in per_trace.values())
+    }
+    e2e_vals = list(e2e.values())
+    e2e_sum = sum(e2e_vals)
+    qw_sum = sum(v.get("queue_wait", 0.0) for t, v in per_trace.items()
+                 if t in e2e)
+
+    # SLO scoring against the objectives that were in force during the
+    # run (the slo/objectives event), over every served request
+    slo_block = None
+    if objectives is not None and all_lats:
+        p99_ms = objectives.get("p99_ms")
+        availability = objectives.get("availability")
+        if isinstance(p99_ms, (int, float)) \
+                and isinstance(availability, (int, float)):
+            bad = sum(1 for v in all_lats if v * 1e3 > p99_ms)
+            frac = bad / len(all_lats)
+            burn = frac / max(1e-9, 1.0 - float(availability))
+            slo_block = {
+                "p99_ms": p99_ms,
+                "availability": availability,
+                "requests": len(all_lats),
+                "violations": bad,
+                "violation_fraction": round(frac, 6),
+                "burn_rate": round(burn, 4),
+                "error_budget_remaining": round(max(0.0, 1.0 - burn), 4),
+            }
+
+    return {
+        "version": SERVE_TRACE_VERSION,
+        "traces": len(per_trace),
+        "hops": hops_block,
+        "e2e": _quantile_block(e2e_vals) if e2e_vals else None,
+        # fraction of traced end-to-end time spent waiting in the queue
+        # — the autoscaler's "add capacity" signal
+        "queue_wait_share": (
+            round(qw_sum / e2e_sum, 4) if e2e_sum > 0 else None
+        ),
+        # forward attempts per routed request; 1.0 = no retries
+        "retry_amplification": (
+            round(hop_spans / route_spans, 4) if route_spans else None
+        ),
+        "per_replica": {
+            rep: _quantile_block(ls)
+            for rep, ls in sorted(by_replica.items())
+        } or None,
+        "per_model": {
+            mdl: _quantile_block(ls)
+            for mdl, ls in sorted(by_model.items())
+        } or None,
+        "slo": slo_block,
+    }
+
+
 # -- skew_report as a library API ---------------------------------------------
 # The autotuner (tpuframe.autotune.diagnosis) and the baseline differ
 # both consume skew_report's dict as a stable contract.  The key sets
 # below ARE that contract: adding a key is backwards-compatible (bump
 # the minor), removing or renaming one breaks consumers (bump the major
 # and update tpuframe/autotune + the golden structural test together).
-SKEW_REPORT_VERSION = "1.1"  # 1.1: + device_time (parsed profiler capture)
+# 1.1: + device_time (parsed profiler capture)
+# 1.2: + serve_trace (per-hop request-path attribution + SLO scoring)
+SKEW_REPORT_VERSION = "1.2"
 
 # Top-level keys, always present (value may be None for the optional
-# blocks: time_to_first_step, health, comms, serve_latency, device_time,
-# slowest).
+# blocks: time_to_first_step, health, comms, serve_latency, serve_trace,
+# device_time, slowest).
 SKEW_REPORT_KEYS = (
     "schema_version", "ranks", "hosts", "steps", "warmup_steps_skipped",
     "compile", "time_to_first_step", "health", "straggler_factor",
-    "comms", "serve_latency", "device_time", "step_time", "step_wall",
-    "total_lost_s", "straggler_lost_s", "straggling_steps",
+    "comms", "serve_latency", "serve_trace", "device_time", "step_time",
+    "step_wall", "total_lost_s", "straggler_lost_s", "straggling_steps",
     "lost_by_bound", "slowest", "per_rank", "per_step",
 )
 
@@ -762,6 +969,9 @@ def skew_report(ranks: Sequence[RankLog], *,
         "straggler_factor": straggler_factor,
         "comms": comms_info,             # wire traffic (baseline diffs)
         "serve_latency": serve_latency,  # request path (baseline diffs)
+        # per-hop request-path attribution from trace-tagged spans
+        # (queue-wait p99 + SLO burn rate gate via baseline diffs)
+        "serve_trace": _serve_trace_info(ranks),
         # parsed profiler capture: per-class device wall, exposed comms,
         # the top-op table (baseline diffs on exposed/device-step)
         "device_time": _device_time_info(ranks),
@@ -817,7 +1027,10 @@ def baseline_diff(report: dict, baseline: str, *,
     step-time regression (exit 3).  Records carrying a ``serve_latency``
     block with ``p99`` (``bench_serve.py`` commits one) diff against the
     report's serve-path latency distribution: a p99 latency regression
-    on the request path gates the same way.  ``backend`` filters the baselines
+    on the request path gates the same way.  Records carrying a
+    ``serve_trace`` block (``bench_serve.py --fleet`` commits one) diff
+    the per-hop queue-wait p99 (``ratio_queue_wait_p99``) and the SLO
+    burn rate (``ratio_burn_rate``) under the same discipline.  ``backend`` filters the baselines
     compared (``"cpu"``/``"tpu"``): without it a CPU run diffed against
     a results dir that also holds TPU records would read ~10x "slower"
     and trip the regression exit code spuriously — pass the backend the
@@ -840,6 +1053,10 @@ def baseline_diff(report: dict, baseline: str, *,
     cur_exposed = (cur_dt.get("exposed_comms_per_step_s")
                    or cur_dt.get("exposed_comms_s"))
     cur_dstep = cur_dt.get("device_step_s")
+    cur_st_block = report.get("serve_trace") or {}
+    cur_qw = ((cur_st_block.get("hops") or {}).get("queue_wait")
+              or {}).get("p99")
+    cur_burn = (cur_st_block.get("slo") or {}).get("burn_rate")
     out: dict = {"threshold": threshold, "backend": backend,
                  "baselines": [], "regressions": []}
     for p in paths:
@@ -865,8 +1082,13 @@ def baseline_diff(report: dict, baseline: str, *,
             dt.get("exposed_comms_per_step_s") or dt.get("exposed_comms_s")
             or dt.get("device_step_s")
         ) else None
+        tr = rec.get("serve_trace")
+        tr = tr if isinstance(tr, dict) and (
+            ((tr.get("hops") or {}).get("queue_wait") or {}).get("p99")
+            or (tr.get("slo") or {}).get("burn_rate")
+        ) else None
         if st is None and tt is None and sv is None and cm is None \
-                and dt is None:
+                and dt is None and tr is None:
             continue
         if backend and rec.get("backend") and rec["backend"] != backend:
             continue
@@ -927,6 +1149,26 @@ def baseline_diff(report: dict, baseline: str, *,
                 entry["ratio_device_step"] = round(
                     cur_dstep / base_dstep, 4
                 )
+        if tr is not None:
+            # request-path regressions gate like step-time ones: queue
+            # wait growing past threshold at flat load (capacity eroded
+            # — the autoscaler's signal regressed) or the SLO burn rate
+            # growing past it (the fleet is spending budget faster than
+            # its baseline).  A run with NO serve_trace block — tracing
+            # off — is incomparable, not a regression, same discipline
+            # as comms/device_time; a zero-burn baseline is likewise
+            # incomparable (no budget was being spent to ratio against).
+            base_qw = ((tr.get("hops") or {}).get("queue_wait")
+                       or {}).get("p99")
+            if base_qw and cur_qw:
+                entry["baseline_queue_wait_p99_s"] = base_qw
+                entry["current_queue_wait_p99_s"] = cur_qw
+                entry["ratio_queue_wait_p99"] = round(cur_qw / base_qw, 4)
+            base_burn = (tr.get("slo") or {}).get("burn_rate")
+            if base_burn and cur_burn:
+                entry["baseline_burn_rate"] = base_burn
+                entry["current_burn_rate"] = cur_burn
+                entry["ratio_burn_rate"] = round(cur_burn / base_burn, 4)
         out["baselines"].append(entry)
         if (entry.get("ratio_p50") and entry["ratio_p50"] > threshold) or (
             entry.get("ratio_ttfs") and entry["ratio_ttfs"] > threshold
@@ -945,6 +1187,12 @@ def baseline_diff(report: dict, baseline: str, *,
         ) or (
             entry.get("ratio_device_step")
             and entry["ratio_device_step"] > threshold
+        ) or (
+            entry.get("ratio_queue_wait_p99")
+            and entry["ratio_queue_wait_p99"] > threshold
+        ) or (
+            entry.get("ratio_burn_rate")
+            and entry["ratio_burn_rate"] > threshold
         ):
             out["regressions"].append(entry)
     return out
@@ -999,6 +1247,35 @@ def format_report(report: dict, diff: dict | None = None, *,
             f"p95={sv['p95'] * 1e3:.1f}ms p99={sv['p99'] * 1e3:.1f}ms "
             f"over {sv['count']} served request(s)"
         )
+    tr = report.get("serve_trace") or {}
+    if tr:
+        hops = tr.get("hops") or {}
+        hop_parts = [
+            f"{h}={hops[h]['p99'] * 1e3:.1f}ms"
+            for h in _TRACE_HOP_ORDER if h in hops
+        ]
+        lines.append(
+            f"  request path ({tr['traces']} traced request(s)), "
+            "p99 by hop: " + " ".join(hop_parts)
+        )
+        extras = []
+        if tr.get("queue_wait_share") is not None:
+            extras.append(f"queue-wait share {tr['queue_wait_share']:.0%}")
+        if tr.get("retry_amplification") is not None:
+            extras.append(
+                f"retry amplification x{tr['retry_amplification']:.2f}"
+            )
+        if extras:
+            lines.append("    " + ", ".join(extras))
+        slo = tr.get("slo") or {}
+        if slo:
+            lines.append(
+                f"  slo: p99 objective {slo['p99_ms']:.0f}ms, "
+                f"availability {slo['availability']}, "
+                f"{slo['violations']}/{slo['requests']} violation(s), "
+                f"burn rate {slo['burn_rate']:.2f} "
+                f"(budget remaining {slo['error_budget_remaining']:.0%})"
+            )
     cm = report.get("comms") or {}
     if cm:
         red = (
@@ -1153,6 +1430,19 @@ def format_report(report: dict, diff: dict | None = None, *,
                     f"device_step {b['baseline_device_step_s'] * 1e3:.2f}ms"
                     f" -> {b['current_device_step_s'] * 1e3:.2f}ms "
                     f"(x{b['ratio_device_step']:.2f})"
+                )
+            if b.get("ratio_queue_wait_p99") is not None:
+                parts.append(
+                    f"queue_wait_p99 "
+                    f"{b['baseline_queue_wait_p99_s'] * 1e3:.2f}ms -> "
+                    f"{b['current_queue_wait_p99_s'] * 1e3:.2f}ms "
+                    f"(x{b['ratio_queue_wait_p99']:.2f})"
+                )
+            if b.get("ratio_burn_rate") is not None:
+                parts.append(
+                    f"burn_rate {b['baseline_burn_rate']:.2f} -> "
+                    f"{b['current_burn_rate']:.2f} "
+                    f"(x{b['ratio_burn_rate']:.2f})"
                 )
             lines.append(
                 f"    vs {b['file']} [{b.get('backend')}]: "
@@ -1435,7 +1725,11 @@ def main(argv: Sequence[str] | None = None) -> int:
             "cross-rank skew report."
         ),
     )
-    ap.add_argument("dir", help="TPUFRAME_TELEMETRY_DIR of a finished run")
+    ap.add_argument("dir", nargs="+",
+                    help="TPUFRAME_TELEMETRY_DIR of a finished run; give "
+                         "several (router + replicas of a multi-process "
+                         "serve fleet) to stitch them onto one timeline "
+                         "keyed by trace id")
     ap.add_argument("--trace", metavar="OUT.json",
                     help="write a Chrome/Perfetto trace.json here")
     ap.add_argument("--report", action="store_true",
@@ -1461,7 +1755,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        ranks = load_dir(args.dir)
+        ranks = load_dirs(args.dir)
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -555,7 +555,20 @@ class Telemetry:
                     self._file = open(self.jsonl_path, "a")
                     self._bytes = self._file.tell()  # append mode: file size
                 self._file.write(line)
-                self._file.flush()
+                # trace-tagged span records ride the stdio buffer: the
+                # traced serve request path emits several per request,
+                # and a flush syscall each would serialize every serving
+                # thread on this lock (measured ~10% on served p50).
+                # Everything else still flushes per line for crash
+                # durability — and each such flush carries any buffered
+                # trace spans with it; the reader already tolerates a
+                # torn buffered tail.
+                a = rec.get("attrs")
+                if not (rec.get("kind") == "span"
+                        and ("trace" in rec or "traces" in rec
+                             or (isinstance(a, dict)
+                                 and ("trace" in a or "traces" in a)))):
+                    self._file.flush()
                 # encoded size, not len(line): non-ASCII payloads (error
                 # strings, hostnames) are 2-4 UTF-8 bytes per char, and
                 # undercounting would let the segment overshoot the cap
