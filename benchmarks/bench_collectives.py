@@ -44,10 +44,28 @@ arm's parsed capture is committed as the record's top-level
 ``device_time`` block — the ``ratio_exposed_comms`` baseline the
 analyzer gates future runs against.
 
+``--fused`` runs the in-collective A/B: the SAME compressed fit (int8,
+EF on) staged (quantize -> one psum -> dequantize) vs fused (the
+payloads ride the backend-dispatched in-collective transport — the
+ring reduce-scatter/all-gather hops on TPU, the single fused
+all-reduce thunk on this CPU host; ``plan.comms_fused`` pins each arm,
+so the env can't leak in).  Matched
+payloads by construction: bytes-on-wire is INVARIANT under fusion (the
+same quantized buckets cross the wire either way — the fused win is hop
+granularity and the encode/decode staging, never wire bytes), and the
+record says so.  Both arms AOT-compiled (zero
+``compile/recompile``/``aot_fallback`` committed), synced grads + EF
+residual compared bit-for-bit across arms, exposed comms measured per
+arm off a parsed capture.  The committed record carries analyzer-
+gateable ``step_time`` + ``comms`` + ``device_time`` blocks
+(``ratio_p50`` / ``ratio_bytes_on_wire`` / ``ratio_exposed_comms``).
+
 Usage: python benchmarks/bench_collectives.py [--payload-mb 8]
            [--iters 30] [--steps 30] [--json-only]
        python benchmarks/bench_collectives.py --overlap
            [--overlap-groups 4] [--overlap-steps 12] [--overlap-width 768]
+       python benchmarks/bench_collectives.py --fused
+           [--overlap-steps 12] [--overlap-width 768] [--bucket-mb 4]
 """
 
 from __future__ import annotations
@@ -366,6 +384,300 @@ def run_overlap(args) -> int:
     return 0 if ok else 4
 
 
+def run_fused(args) -> int:
+    """The in-collective A/B: staged wire vs the fused transport (form
+    backend-dispatched — ring on TPU, single thunk on CPU), same
+    model, same batches, same seeds — each arm pinned by
+    ``plan.comms_fused`` so the comparison can't be skewed by env.  The
+    contract under test is the tentpole's: fusing the transport changes
+    WHERE the payloads cross the wire, never a bit of what arrives."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from tpuframe.compile.precompile import (
+        ShapeGuard,
+        abstract_state,
+        batch_signature,
+        precompile_call,
+    )
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.parallel import ParallelPlan
+    from tpuframe.parallel.compression import (
+        CommsConfig,
+        comms_template,
+        grad_layout,
+        init_comms_state,
+        make_compressed_pmean,
+        wire_plan,
+    )
+    from tpuframe.track.device_time import device_time_report
+    from tpuframe.track.profiler import trace
+    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.train import (
+        create_train_state,
+        make_grad_accum_step,
+        make_train_step,
+    )
+
+    world = len(jax.devices())
+    mesh = MeshSpec(data=world).build()
+    width = int(args.overlap_width)
+    n_steps = int(args.overlap_steps)
+    per_dev = int(args.overlap_batch)
+    accum = max(1, int(args.overlap_accum))
+    warmup = 3
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            for _ in range(4):
+                x = nn.relu(nn.Dense(width)(x))
+            return nn.Dense(16)(x)
+
+    config = CommsConfig(
+        mode="int8", bucket_mb=args.bucket_mb, error_feedback=True
+    )
+    tele = get_telemetry()
+    plan_staged = ParallelPlan(mesh=mesh, comms_fused=False)
+    plan_fused = ParallelPlan(mesh=mesh, comms_fused=True)
+
+    def mk_state(plan):
+        s = create_train_state(
+            Net(), jax.random.PRNGKey(0),
+            jnp.ones((1, 16, 16, 1), jnp.float32), optax.adamw(1e-3),
+            plan=plan,
+        )
+        return s.replace(comms=init_comms_state(s.params, plan, config))
+
+    def mk_batches(plan, n):
+        # grad-accum batches: the hop-granularity story needs backward
+        # compute for the per-hop sends to hide behind — same shape as
+        # the overlap A/B
+        r = np.random.default_rng(7)
+        out = []
+        for _ in range(n):
+            shape = (accum, per_dev * world) if accum > 1 else (per_dev * world,)
+            img = r.standard_normal(shape + (16, 16, 1)).astype(np.float32)
+            lab = r.integers(0, 16, shape).astype(np.int32)
+            out.append(plan.shard_batch(
+                {"image": img, "label": lab}, leading_microbatch=accum > 1,
+            ))
+        return out
+
+    def bits_equal(a, b) -> bool:
+        la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+        return len(la) == len(lb) and all(
+            np.asarray(x).tobytes() == np.asarray(y).tobytes()
+            for x, y in zip(la, lb)
+        )
+
+    # the bit-exactness contract is on the SYNC: same params, same
+    # grads, same residual -> the fused transport must hand back the
+    # identical mean gradient and EF residual, bit for bit.  Runs
+    # BEFORE the fits (the train step donates its state).
+    s0 = mk_state(plan_staged)
+
+    def loss(params, img, lab):
+        logits = s0.apply_fn({"params": params}, img)
+        oh = jax.nn.one_hot(lab, 16)
+        return -jnp.mean(jnp.sum(oh * jax.nn.log_softmax(logits), -1))
+
+    rr = np.random.default_rng(7)
+    img = jnp.asarray(rr.standard_normal((16, 16, 16, 1)), jnp.float32)
+    lab = jnp.asarray(rr.integers(0, 16, 16), jnp.int32)
+    grads = jax.grad(loss)(s0.params, img, lab)
+    resid = {
+        k: jnp.zeros(v, jnp.float32)
+        for k, v in comms_template(s0.params, config, plan_staged).items()
+    }
+    os_, rs_ = make_compressed_pmean(plan_staged, config)(grads, resid)
+    of_, rf_ = make_compressed_pmean(plan_fused, config)(grads, resid)
+    bit_exact = bits_equal(os_, of_)
+    bit_exact_resid = bits_equal(rs_, rf_)
+    del os_, rs_, of_, rf_
+
+    # standalone collective wall per arm on the model's own gradients —
+    # the comms.allreduce_s the analyzer ratios
+    ar_staged, _ = time_collective(
+        make_compressed_pmean(plan_staged, config), grads, resid, 10)
+    ar_fused, _ = time_collective(
+        make_compressed_pmean(plan_fused, config), grads, resid, 10)
+    del s0, grads, resid
+
+    def run_arm(plan, tag: str) -> dict:
+        if accum > 1:
+            step = make_grad_accum_step(
+                accum, plan=plan, grad_compression=config
+            )
+        else:
+            step = make_train_step(plan=plan, grad_compression=config)
+        state = mk_state(plan)
+        batches = mk_batches(plan, warmup + n_steps)
+        recompiles0 = tele.registry.counter("compile/recompiles").value
+        compiled = precompile_call(
+            step, (abstract_state(state), batches[0]),
+            label=f"bench/fused@{tag}",
+        )
+        guard = ShapeGuard(tele)
+        guard.expect("train", batch_signature(batches[0]))
+        fallbacks = 0
+
+        def dispatch(state, batch):
+            nonlocal fallbacks
+            guard.check("train", batch_signature(batch))
+            if compiled is not None:
+                try:
+                    return compiled(state, batch)
+                except Exception as e:
+                    fallbacks += 1
+                    tele.event(
+                        "compile/aot_fallback", step_kind="train",
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
+            return step(state, batch)
+
+        for b in batches[:warmup]:
+            state, metrics = dispatch(state, b)
+            jax.block_until_ready(metrics)
+        walls = []
+        logdir = tempfile.mkdtemp(prefix=f"tpuframe_fused_{tag}_")
+        with trace(logdir):
+            for b in batches[warmup:]:
+                t0 = time.perf_counter()
+                state, metrics = dispatch(state, b)
+                jax.block_until_ready(metrics)
+                walls.append(time.perf_counter() - t0)
+            jax.block_until_ready(state)
+        dt = device_time_report(logdir, steps=n_steps) or {}
+        dt["trace_dir"] = None
+        shutil_rmtree(logdir)
+        walls = sorted(walls)
+        wire = getattr(step, "wire", None) or wire_plan(
+            grad_layout(state.params, config, plan), config
+        )
+        return {
+            "tag": tag,
+            "state": state,
+            "wire": wire,
+            "walls": walls,
+            "device_time": dt,
+            "step_p50_s": round(statistics.median(walls), 6),
+            "recompile_events": int(
+                tele.registry.counter("compile/recompiles").value
+                - recompiles0
+            ),
+            "aot_fallback_events": fallbacks,
+            "aot_dispatch": compiled is not None,
+        }
+
+    staged = run_arm(plan_staged, "staged")
+    fused = run_arm(plan_fused, "fused")
+    params_drift = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree.leaves(staged["state"].params),
+            jax.tree.leaves(fused["state"].params),
+        )
+    )
+
+    def arm_rec(arm: dict) -> dict:
+        dt = arm["device_time"]
+        return {
+            "fused": arm["tag"] == "fused",
+            "step_p50_s": arm["step_p50_s"],
+            "exposed_comms_per_step_s": dt.get("exposed_comms_per_step_s"),
+            "overlap_efficiency": dt.get("overlap_efficiency"),
+            "collective_wall_s": (
+                (dt.get("classes") or {}).get("collective") or {}
+            ).get("wall_s"),
+            "recompile_events": arm["recompile_events"],
+            "aot_fallback_events": arm["aot_fallback_events"],
+            "aot_dispatch": arm["aot_dispatch"],
+        }
+
+    se = staged["device_time"].get("exposed_comms_per_step_s") or 0.0
+    fe = fused["device_time"].get("exposed_comms_per_step_s") or 0.0
+    fw = fused["wire"]
+    walls = fused["walls"]
+    rec = {
+        "benchmark": "collectives_fused",
+        "backend": jax.default_backend(),
+        "world": world,
+        "mode": "int8_ef",
+        "model_params_mb": round(
+            sum(int(x.size) for x in jax.tree.leaves(fused["state"].params))
+            * 4 / (1 << 20), 3,
+        ),
+        "steps_per_arm": n_steps,
+        "fused_ab": {
+            "staged": arm_rec(staged),
+            "fused": arm_rec(fused),
+            "bit_exact_synced_grads": bit_exact,
+            "bit_exact_ef_residual": bit_exact_resid,
+            "final_params_max_abs_diff": params_drift,
+            "allreduce_p50_staged_s": ar_staged["p50_s"],
+            "allreduce_p50_fused_s": ar_fused["p50_s"],
+            # <= 1.0 means fused exposed no more collective wall than
+            # staged — the number the acceptance bar reads
+            "exposed_ratio_fused_vs_staged": (
+                round(fe / se, 3) if se and fe else None
+            ),
+        },
+        # bytes are INVARIANT under fusion — committed so a future run
+        # that breaks the invariant (fused padding leaking onto the
+        # wire) diffs loudly instead of silently
+        "bytes_on_wire": {
+            "f32_bytes_per_step": fw.get("f32_bytes_per_step"),
+            "bytes_per_step": fw.get("bytes_per_step"),
+            "reduction_x": fw.get("reduction_x"),
+            "invariant_under_fusion": (
+                staged["wire"].get("bytes_per_step")
+                == fw.get("bytes_per_step")
+            ),
+            "fused_hops": fw.get("fused_hops"),
+        },
+        # the fused arm IS the configuration this record recommends:
+        # its step distribution + capture are the baselines the
+        # analyzer gates against (ratio_p50 / ratio_exposed_comms)
+        "step_time": {
+            "p50": round(statistics.median(walls), 6),
+            "p95": round(walls[max(0, int(len(walls) * 0.95) - 1)], 6),
+            "count": len(walls),
+        },
+        "comms": {
+            "mode": "int8",
+            "error_feedback": True,
+            "fused": True,
+            "bytes_per_step": fw.get("bytes_per_step"),
+            "f32_bytes_per_step": fw.get("f32_bytes_per_step"),
+            "reduction_x": fw.get("reduction_x"),
+            "allreduce_s": {"p50": ar_fused["p50_s"]},
+        },
+        "wire": {
+            k: fw.get(k)
+            for k in ("mode", "world", "n_buckets", "bucket_elems",
+                      "bytes_per_step", "fused", "fused_hops")
+        },
+        "device_time": fused["device_time"],
+    }
+    print(json.dumps(rec, indent=1))
+    ok = (
+        bit_exact
+        and bit_exact_resid
+        and staged["recompile_events"] == 0
+        and fused["recompile_events"] == 0
+        and staged["aot_fallback_events"] == 0
+        and fused["aot_fallback_events"] == 0
+    )
+    return 0 if ok else 4
+
+
 def shutil_rmtree(path: str) -> None:
     import shutil
 
@@ -381,6 +693,8 @@ def main() -> int:
     ap.add_argument("--bucket-mb", type=float, default=4.0)
     ap.add_argument("--overlap", action="store_true",
                     help="run the bucket-group overlap A/B instead")
+    ap.add_argument("--fused", action="store_true",
+                    help="run the staged-vs-in-collective wire A/B instead")
     ap.add_argument("--overlap-groups", type=int, default=4)
     ap.add_argument("--overlap-steps", type=int, default=12)
     ap.add_argument("--overlap-width", type=int, default=768)
@@ -400,6 +714,8 @@ def main() -> int:
 
     if args.overlap:
         return run_overlap(args)
+    if args.fused:
+        return run_fused(args)
 
     import jax
     import jax.numpy as jnp

@@ -128,7 +128,7 @@ run() { # outfile, timeout_s, cmd...  (stderr lands beside it as .err)
 # rung.  Each promotes immediately — a kill at t=600s keeps all four.
 run bench_live.json            600  python bench.py
 run check_kernels_subset.json  300  python benchmarks/check_kernels_tpu.py \
-  --only layer_norm,cross_entropy,normalize
+  --only layer_norm,cross_entropy,normalize,quant_wire
 run check_offload_tpu.json     600  python benchmarks/check_offload_tpu.py
 
 # end-to-end data-fed bench (VERDICT r04 #4): JPEG shards -> decode ->
@@ -238,6 +238,18 @@ run bench_collectives.json    300  python benchmarks/bench_collectives.py
 TPUFRAME_COMMS_ASYNC=1 \
 run bench_overlap.json        600  python benchmarks/bench_collectives.py \
   --overlap --overlap-width 1536 --bucket-mb 2.0
+
+# fused-wire rung: in-collective compressed transport vs the staged
+# stage→psum→decode wire through the REAL grad-accum train step — fused
+# must be bit-exact on synced grads + EF residual with bytes_on_wire
+# invariant under fusion, and the committed step_time/device_time
+# blocks are what `track analyze --baseline` gates ratio_step_p50 /
+# ratio_exposed_comms against (exit 3).  On the TPU host the transport
+# takes the hop-pipelined ring form (default_backend() == "tpu"), so
+# this rung is where fused_hops stop being a static ring-model count
+# and start hiding under per-hop compute
+run bench_fused.json          600  python benchmarks/bench_collectives.py \
+  --fused
 
 # compile-spine rung: cold vs warm-cache vs AOT-overlapped
 # time-to-first-step on the real chip — the committed

@@ -9,12 +9,15 @@ Run it on TPU whenever a kernel, its block specs, or its dispatch
 changes.  One JSON line per check: {"check", "max_abs_diff", "pass"}.
 
 Covers: fused LayerNorm (fwd+grads), fused cross-entropy (fwd+grad),
-fused AdamW (vs optax), fused normalize, blockwise attention
-(fwd+grads, causal and not), ring attention oracle parity on one device.
+fused AdamW (vs optax), fused normalize, the quant_wire trio
+(amax/encode/decode vs the staged jnp expressions — the in-collective
+wire's arithmetic contract), blockwise attention (fwd+grads, causal and
+not), ring and ulysses attention oracle parity on one device.
 
 Usage: python benchmarks/check_kernels_tpu.py [--only a,b,...]
 (exits 1 on any failure).  ``--only`` runs a named subset — sections:
-layer_norm, cross_entropy, adamw, normalize, blockwise, ring.  The
+layer_norm, cross_entropy, adamw, normalize, quant_wire, blockwise,
+ring, ulysses.  The
 capture script's value-ordered pass runs a cheap elementwise subset
 first (layer_norm,cross_entropy,normalize) so a short live window still
 lands kernel evidence before the expensive attention sections.
@@ -40,7 +43,7 @@ def record(check: str, diff: float, tol: float) -> None:
 
 
 SECTIONS = ("layer_norm", "cross_entropy", "adamw", "normalize",
-            "blockwise", "ring")
+            "quant_wire", "blockwise", "ring", "ulysses")
 
 
 def main() -> None:
@@ -90,10 +93,18 @@ def main() -> None:
     if want("normalize"):
         _check_normalize(jax, jnp, np, rng)
 
+    # --- quant_wire: the in-collective wire's amax/encode/decode ---------
+    if want("quant_wire"):
+        _check_quant_wire(jax, jnp, np, rng)
+
     # --- attention: blockwise fwd/grads + ring shard_map path ------------
     if want("blockwise") or want("ring"):
         _check_attention(jax, jnp, np, rng,
                          blockwise=want("blockwise"), ring=want("ring"))
+
+    # --- ulysses attention: the all-to-all shard_map path ----------------
+    if want("ulysses"):
+        _check_ulysses(jax, jnp, np, rng)
 
     raise SystemExit(0 if all(RESULTS) else 1)
 
@@ -171,6 +182,60 @@ def _check_normalize(jax, jnp, np, rng) -> None:
     )
 
 
+def _check_quant_wire(jax, jnp, np, rng) -> None:
+    from tpuframe.ops.quant_wire import (
+        bucket_abs_max,
+        bucket_abs_max_reference,
+        quant_decode,
+        quant_decode_reference,
+        quant_encode,
+        quant_encode_reference,
+    )
+
+    # ragged shapes exercise the padded-tile mask and the column-block
+    # accumulation; the aligned one is the fast path
+    for shape in ((8, 2048), (17, 4096), (3, 130)):
+        vv = jnp.asarray(rng.standard_normal(shape) * 7, jnp.float32)
+        record(
+            f"quant_wire_amax_{shape[0]}x{shape[1]}",
+            float(jnp.max(jnp.abs(
+                jax.jit(bucket_abs_max)(vv) - bucket_abs_max_reference(vv)
+            ))),
+            1e-6,
+        )
+    vv = jnp.asarray(rng.standard_normal((17, 4096)) * 5, jnp.float32)
+    amax = bucket_abs_max_reference(vv)
+    noise = jnp.asarray(rng.uniform(0, 1, vv.shape), jnp.float32)
+    cases = [("int8", "rtn", None), ("int8", "sr", noise), ("fp8", "rtn", None)]
+    for mode, tag, nz in cases:
+        qk, dk = jax.jit(
+            lambda v, a, m=mode, n=nz: quant_encode(v, a, m, noise=n)
+        )(vv, amax)
+        qr, dr = quant_encode_reference(vv, amax, mode, noise=nz)
+        record(
+            f"quant_wire_encode_{mode}_{tag}",
+            max(
+                float(jnp.max(jnp.abs(
+                    qk.astype(jnp.float32) - qr.astype(jnp.float32)))),
+                float(jnp.max(jnp.abs(dk - dr))),
+            ),
+            1e-6,
+        )
+    for mode in ("int8", "fp8"):
+        q, _ = quant_encode_reference(vv, amax, mode)
+        total = q.astype(jnp.float32) * 8
+        total = total.astype(jnp.int32) if mode == "int8" else total
+        record(
+            f"quant_wire_decode_{mode}",
+            float(jnp.max(jnp.abs(
+                jax.jit(lambda t, a, m=mode: quant_decode(t, a, m, 8))(
+                    total, amax)
+                - quant_decode_reference(total, amax, mode, 8)
+            ))),
+            1e-4,
+        )
+
+
 def _check_attention(jax, jnp, np, rng, *, blockwise: bool, ring: bool) -> None:
     # --- blockwise attention: fwd + grads, causal and bidirectional ------
     from tpuframe.ops.blockwise_attention import blockwise_attention
@@ -224,6 +289,36 @@ def _check_attention(jax, jnp, np, rng, *, blockwise: bool, ring: bool) -> None:
     record(
         "ring_grads_1dev",
         max(float(jnp.max(jnp.abs(a - c))) for a, c in zip(gr3, go3)),
+        2e-2,
+    )
+
+
+def _check_ulysses(jax, jnp, np, rng) -> None:
+    # One chip means a 1-device seq axis (the all-to-alls are identity
+    # re-shards) — still the real shard_map lowering and the dense
+    # attention body on-device, same bar as the ring rung.
+    from jax.sharding import Mesh
+
+    from tpuframe.ops.ring_attention import attention_reference
+    from tpuframe.ops.ulysses import ulysses_attention
+
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 300, 4, 32)) * 0.3,
+                           jnp.float32) for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
+    got = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh, causal=True, batch_axes=("data",)))(q, k, v)
+    want = attention_reference(q, k, v, causal=True)
+    record("ulysses_fwd_1dev", float(jnp.max(jnp.abs(got - want))), 2e-4)
+    gu = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(ulysses_attention(
+            q, k, v, mesh, causal=True, batch_axes=("data",)) ** 2),
+        (0, 1, 2)))(q, k, v)
+    go4 = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(attention_reference(q, k, v, causal=True) ** 2),
+        (0, 1, 2)))(q, k, v)
+    record(
+        "ulysses_grads_1dev",
+        max(float(jnp.max(jnp.abs(a - c))) for a, c in zip(gu, go4)),
         2e-2,
     )
 

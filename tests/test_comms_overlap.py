@@ -273,7 +273,8 @@ class TestScheduleArtifact:
         mesh = _mesh(8)
         sched = ParallelPlan(mesh=mesh).comms_schedule()
         assert sched == {
-            "groups": 1, "order": "reverse_backward", "pinned": False}
+            "groups": 1, "order": "reverse_backward", "pinned": False,
+            "fused": False, "fused_pinned": False}
         # env/config default fills in when the plan doesn't pin...
         sched = ParallelPlan(mesh=mesh).comms_schedule(
             CommsConfig(mode="int8", groups=3))

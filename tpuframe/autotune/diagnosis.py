@@ -37,6 +37,12 @@ __all__ = ["Diagnosis", "KnobMove", "diagnose"]
 #: below this fraction of total step wall, a bottleneck class is noise
 _SIGNIFICANT = 0.10
 
+#: base-op name tokens that identify the compressed wire's staged
+#: encode/decode math in a ``device_time.top_ops`` row — the
+#: scale/round/clip/dequant chain XLA emits around a staged collective
+#: (convert + round-nearest + clamp/floor on the bucket arrays)
+_WIRE_MATH_OPS = ("convert", "round", "clamp", "floor", "clip", "quant")
+
 
 @dataclasses.dataclass(frozen=True)
 class KnobMove:
@@ -202,12 +208,43 @@ def diagnose(report: dict, *, gauges: dict | None = None) -> Diagnosis:
              "comms-bound: accumulate micro-batches, sync once per "
              "super-batch")
     elif bound == "compute":
-        # compute-bound is the healthy state; no knob move — but when a
-        # parsed capture exists, name WHERE the compute goes (the top-op
-        # table is the fused-kernel target list ROADMAP item 3(b) reads)
+        # compute-bound is the healthy baseline; moves exist only when a
+        # parsed capture NAMES where the compute goes — the top-op table
+        # is the fusion target list (ROADMAP item 3(b)), and this branch
+        # is its first consumer.  Every move still has to win the
+        # never-commit-slower probe, so a wrong attribution costs probe
+        # time, never a slower run.
         top = (report.get("device_time") or {}).get("top_ops")
         if top:
             detail["top_ops"] = top[:5]
+            comms = report.get("comms") or {}
+            wire_on = (comms.get("mode") or "none") not in ("none", "")
+            wire_math = [
+                op for op in top[:5]
+                if any(tok in (op.get("name") or "").lower()
+                       for tok in _WIRE_MATH_OPS)
+            ]
+            if wire_math and wire_on:
+                names = ",".join(op.get("name") or "?" for op in wire_math[:3])
+                pct = sum(op.get("pct") or 0.0 for op in wire_math)
+                move("TPUFRAME_COMMS_FUSED", True,
+                     f"compute-bound on staged wire math ({names}: "
+                     f"{pct:.1f}% of device time with compression on) — "
+                     "fuse encode/decode into the collective hops and let "
+                     "the quant_wire kernels do each stage in one VMEM "
+                     "pass")
+            fusable = [
+                op for op in top[:5]
+                if op.get("class") == "compute"
+                and (op.get("pct") or 0.0) >= 100.0 * _SIGNIFICANT
+            ]
+            if fusable:
+                names = ",".join(op.get("name") or "?" for op in fusable[:3])
+                move("TPUFRAME_DISABLE_PALLAS", False,
+                     f"compute-bound on fusable ops ({names}) — make sure "
+                     "the Pallas kernel paths (layer_norm, cross_entropy, "
+                     "adamw, quant_wire) are engaged, not the staged jnp "
+                     "references")
 
     # compile block rides along regardless of bound: a cold compile that
     # dominates the window says the cache/precompiler are off

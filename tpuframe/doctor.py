@@ -412,6 +412,12 @@ def comms_section() -> dict:
     out["enabled"] = config is not None
     if config is not None:
         out["config"] = dataclasses.asdict(config)
+    # in-collective wire: fused is resolved off the same config (it is
+    # a no-op without a compressed mode), and the A/B arm is the proof
+    out["fused"] = {
+        "enabled": bool(config is not None and config.fused),
+        "bench": "python benchmarks/bench_collectives.py --fused",
+    }
     return out
 
 

@@ -17,43 +17,87 @@ fallback and the correctness oracle for tests.
 - :func:`fused_adamw` — one-kernel AdamW moment+param update (the
   DeepSpeed "fused Adam" role, engaged via its ZeRO configs,
   `/root/reference/02_deepspeed/deepspeed_config.py:28-40`).
+- :func:`quant_encode` / :func:`quant_decode` — the compressed gradient
+  wire's amax/scale/round/pack stages in one VMEM pass each
+  (``parallel.compression`` calls them for the bucketed transport).
+
+Exports are lazy (PEP 562, like ``tpuframe.parallel``): resolving a
+name off this package must not import jax, so the knob registries and
+the doctor can enumerate op modules from wedged-backend or jax-less
+processes — importing a *resolved* symbol still pulls in the real
+kernel module.
 """
 
-from tpuframe.ops.dispatch import use_pallas
-from tpuframe.ops.normalize import normalize_images, normalize_images_reference
-from tpuframe.ops.cross_entropy import (
-    fused_cross_entropy,
-    cross_entropy_reference,
-)
-from tpuframe.ops.fused_adamw import fused_adamw, fused_adamw_update
-from tpuframe.ops.layer_norm import (
-    FusedLayerNorm,
-    fused_layer_norm,
-    layer_norm_reference,
-)
-from tpuframe.ops.blockwise_attention import blockwise_attention
-from tpuframe.ops.ulysses import ulysses_attention, ulysses_attention_local
-from tpuframe.ops.ring_attention import (
-    attention_reference,
-    ring_attention,
-    ring_attention_local,
-)
+# tpuframe-lint: stdlib-only
 
-__all__ = [
-    "blockwise_attention",
-    "attention_reference",
-    "ring_attention",
-    "ring_attention_local",
-    "ulysses_attention",
-    "ulysses_attention_local",
-    "FusedLayerNorm",
-    "fused_layer_norm",
-    "layer_norm_reference",
-    "use_pallas",
-    "normalize_images",
-    "normalize_images_reference",
-    "fused_cross_entropy",
-    "cross_entropy_reference",
-    "fused_adamw",
-    "fused_adamw_update",
-]
+import sys as _sys
+import types as _types
+
+_LAZY = {
+    "use_pallas": "tpuframe.ops.dispatch",
+    "normalize_images": "tpuframe.ops.normalize",
+    "normalize_images_reference": "tpuframe.ops.normalize",
+    "fused_cross_entropy": "tpuframe.ops.cross_entropy",
+    "cross_entropy_reference": "tpuframe.ops.cross_entropy",
+    "fused_adamw": "tpuframe.ops.fused_adamw",
+    "fused_adamw_update": "tpuframe.ops.fused_adamw",
+    "FusedLayerNorm": "tpuframe.ops.layer_norm",
+    "fused_layer_norm": "tpuframe.ops.layer_norm",
+    "layer_norm_reference": "tpuframe.ops.layer_norm",
+    "blockwise_attention": "tpuframe.ops.blockwise_attention",
+    "ulysses_attention": "tpuframe.ops.ulysses",
+    "ulysses_attention_local": "tpuframe.ops.ulysses",
+    "attention_reference": "tpuframe.ops.ring_attention",
+    "ring_attention": "tpuframe.ops.ring_attention",
+    "ring_attention_local": "tpuframe.ops.ring_attention",
+    "bucket_abs_max": "tpuframe.ops.quant_wire",
+    "bucket_abs_max_reference": "tpuframe.ops.quant_wire",
+    "quant_encode": "tpuframe.ops.quant_wire",
+    "quant_encode_reference": "tpuframe.ops.quant_wire",
+    "quant_decode": "tpuframe.ops.quant_wire",
+    "quant_decode_reference": "tpuframe.ops.quant_wire",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def _resolve(name):
+    import importlib
+
+    return getattr(importlib.import_module(_LAZY[name]), name)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        return _resolve(name)
+    raise AttributeError(f"module 'tpuframe.ops' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))
+
+
+class _OpsModule(_types.ModuleType):
+    """Three exports share their kernel module's name
+    (``blockwise_attention``, ``fused_adamw``, ``ring_attention``), and
+    importing such a submodule makes the import machinery rebind the
+    module object over the package attribute of the same name — which
+    would shadow the function for every later
+    ``from tpuframe.ops import ...``, import-order dependent.  Data
+    descriptors on the module's class outrank instance attributes, so
+    these properties keep resolving to the kernel *function* regardless
+    of import order; the machinery's rebind is swallowed (the submodule
+    itself stays importable through ``sys.modules``)."""
+
+
+def _shadow_proof(name):
+    return property(
+        lambda _self: _resolve(name),
+        lambda _self, _value: None,
+    )
+
+
+for _name in ("blockwise_attention", "fused_adamw", "ring_attention"):
+    setattr(_OpsModule, _name, _shadow_proof(_name))
+
+_sys.modules[__name__].__class__ = _OpsModule

@@ -32,6 +32,23 @@ Knob semantics (the one table, mirrored in OBSERVABILITY.md):
   schedule, never the arithmetic.  A ``ParallelPlan.comms_groups``
   override wins over the env (the plan is the first-class schedule
   artifact).
+- ``TPUFRAME_COMMS_FUSED`` — ``1`` fuses the quantized wire *into* the
+  collective: the staged single-``psum`` transport is replaced by a
+  manual ring reduce-scatter / all-gather over the data axes whose hops
+  carry the 8-bit payloads directly (per-bucket scales agreed once up
+  front, partial sums accumulated exactly on arrival), so quantized
+  bytes — not f32 — are what cross the wire on every hop.  Bit-exact
+  against the staged path in every mode: int8 partials are integer
+  sums, fp8-e4m3 grid values are multiples of 2^-9 bounded by 448 so
+  f32 partial sums stay exact through world sizes <= 73 (beyond that
+  the fp8 wire falls back to staged rather than drift).  Requires a
+  single data axis; multi-axis meshes and world size 1 fall back to
+  the staged path.  A ``ParallelPlan.comms_fused`` override wins over
+  the env (same plan-first rule as ``comms_groups``).
+- ``TPUFRAME_COMMS_FUSED_BLOCK`` — column-block element count for the
+  ``ops.quant_wire`` Pallas encode/decode kernels (default 2048, lane
+  multiple).  Larger blocks amortize grid overhead; smaller ones fit
+  tighter VMEM budgets next to the ring buffers.
 - ``TPUFRAME_COMMS_ASYNC`` — ``1`` turns on the backend's
   latency-hiding-scheduler / async-collective-fusion XLA flags at
   ``core.runtime.initialize`` (:func:`comms_async_flags` is the one
@@ -55,6 +72,7 @@ __all__ = [
     "comms_async_enabled",
     "comms_async_flags",
     "comms_async_platform",
+    "comms_fused_block",
 ]
 
 #: the comms spine's env knobs — aggregated by
@@ -65,6 +83,8 @@ COMMS_ENV_VARS = (
     "TPUFRAME_COMMS_STOCHASTIC",
     "TPUFRAME_COMMS_EF",
     "TPUFRAME_COMMS_GROUPS",
+    "TPUFRAME_COMMS_FUSED",
+    "TPUFRAME_COMMS_FUSED_BLOCK",
     "TPUFRAME_COMMS_ASYNC",
 )
 
@@ -80,6 +100,9 @@ COMMS_ENV_DOMAINS = {
     "TPUFRAME_COMMS_EF": {"type": "bool", "apply": "restart"},
     "TPUFRAME_COMMS_GROUPS": {
         "type": "int", "range": (1, 64), "apply": "restart"},
+    "TPUFRAME_COMMS_FUSED": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_COMMS_FUSED_BLOCK": {
+        "type": "int", "range": (128, 65536), "apply": "restart"},
     "TPUFRAME_COMMS_ASYNC": {"type": "bool", "apply": "restart"},
 }
 
@@ -192,6 +215,11 @@ class CommsConfig:
     #: bucket-group count for the scheduled sync (1 = single shot).
     #: More groups than buckets clamps down at layout build.
     groups: int = 1
+    #: in-collective transport: ring reduce-scatter/all-gather whose
+    #: hops carry the 8-bit payloads (False = staged psum around one
+    #: encode/decode).  Falls back to staged on multi-axis meshes,
+    #: world size 1, and fp8 beyond the exact-sum world bound.
+    fused: bool = False
 
     def __post_init__(self):
         if self.mode not in COMPRESSION_MODES:
@@ -236,4 +264,20 @@ class CommsConfig:
             stochastic_rounding=_env_bool("TPUFRAME_COMMS_STOCHASTIC", False),
             error_feedback=_env_bool("TPUFRAME_COMMS_EF", True),
             groups=max(1, _env_int("TPUFRAME_COMMS_GROUPS", 1)),
+            fused=_env_bool("TPUFRAME_COMMS_FUSED", False),
         )
+
+
+def comms_fused_block(environ: dict | None = None) -> int:
+    """Column-block element count for the ``ops.quant_wire`` kernels
+    (``TPUFRAME_COMMS_FUSED_BLOCK``), clamped to the declared domain and
+    rounded down to a lane multiple.  Lives here — not in ops/ — so the
+    knob's one read site sits next to its registry row."""
+    env = os.environ if environ is None else environ
+    raw = str(env.get("TPUFRAME_COMMS_FUSED_BLOCK", "") or "").strip()
+    try:
+        val = int(raw) if raw else 2048
+    except ValueError:
+        val = 2048
+    val = max(128, min(65536, val))
+    return (val // 128) * 128

@@ -23,6 +23,22 @@ is both ideas in tpuframe form:
   the f32 one instead of accumulating bias.  The residual is ordinary
   checkpoint state: it rides the topology manifest, and
   reshard-on-restore folds it onto a different world size.
+- **in-collective transport** (``TPUFRAME_COMMS_FUSED``) — the staged
+  form stages encode/decode *around* one ``psum``; the fused form puts
+  the compression *inside* the collective: a reduce-scatter /
+  all-gather over the data axis whose hops carry the narrow 8-bit/int16
+  containers (scales still agreed once up front by the tiny ``pmax``),
+  partial sums accumulated exactly on arrival (int32 for int8; f32 for
+  the fp8 grid, exact through world <= 73 since e4m3 values are
+  multiples of 2^-9 bounded by 448).  The transport *form* is
+  backend-dispatched by measurement (:func:`_form_default`): a manual
+  hop-pipelined ring on TPU, one concurrent all-to-all + local grid
+  sum on GPU, the backend's own single fused all-reduce thunk on CPU.
+  Because the hop sums equal the staged psum bit-for-bit and the
+  dequant expression is shared, the fused wire is bit-exact against
+  staged in every mode and form — it changes *when and how narrow the
+  bytes move*, never the arithmetic.  Falls back to staged on
+  multi-axis meshes, world 1, and fp8 past the exact-sum bound.
 - **plan-derived update sharding** — for ZeRO-1/2 plans the big leaves
   take a compressed ``psum_scatter`` (reduce-scatter) over the data
   axes, the optimizer updates only the owned slice against the plan's
@@ -67,6 +83,8 @@ __all__ = [
     "sync_gradients",
     "wire_plan",
     "make_compressed_pmean",
+    "fused_active",
+    "resolve_fused",
 ]
 
 QUANT_BITS = 8
@@ -339,6 +357,239 @@ def _encode(v, amax, config: CommsConfig, rng, noise=None):
     return q.astype(jnp.int32), scale
 
 
+# -- in-collective (fused ring) transport -------------------------------------
+
+#: beyond this world size the fp8 wire's f32 partial sums could round:
+#: e4m3 grid values are integer multiples of 2^-9 bounded by 448, so a
+#: W-term sum stays exactly representable in f32 while
+#: W * 448 * 512 <= 2^24.  Past that the fused path falls back to
+#: staged rather than drift from bit-exactness.
+_FP8_EXACT_WORLD = 73
+
+#: below this world size there is no wire to fuse — one shard is the
+#: no-wire identity on the staged path too
+_MIN_FUSED_WORLD = 2
+
+
+def fused_active(layout: GradLayout, config: CommsConfig) -> bool:
+    """Does the in-collective (fused ring) transport engage for this
+    layout?  Requires the knob, a single data axis with world > 1 (the
+    manual ring is written over one named axis; W=1 is the no-wire
+    identity either way), and — for fp8 — a world size inside the
+    exact-partial-sum bound (:data:`_FP8_EXACT_WORLD`)."""
+    if not getattr(config, "fused", False):
+        return False
+    if len(layout.axes) != 1 or layout.world < _MIN_FUSED_WORLD:
+        return False
+    if config.mode == "fp8" and layout.world > _FP8_EXACT_WORLD:
+        return False
+    return True
+
+
+def resolve_fused(plan: Any, config: CommsConfig | None) -> CommsConfig | None:
+    """Fold a pinned ``ParallelPlan.comms_fused`` into ``config`` — the
+    plan wins over the env-resolved knob, same plan-first rule as
+    ``comms_groups`` / ``comms_schedule``."""
+    pinned = getattr(plan, "comms_fused", None)
+    if config is None or pinned is None:
+        return config
+    return dataclasses.replace(config, fused=bool(pinned))
+
+
+def _form_default() -> str:
+    """Which fused transport form to build for this backend:
+
+    - ``"ring"`` (TPU): hop-pipelined manual reduce-scatter/all-gather —
+      per-hop sends the latency-hiding scheduler overlaps on real
+      topology, hops carry narrowed (int16 partial) containers.
+    - ``"concurrent"`` (GPU): one all-to-all of the true one-byte
+      containers + a LOCAL grid sum the compiler schedules as compute +
+      one all-gather — hop structure without sequential dispatch.
+    - ``"single"`` (CPU and anything else without an async collective
+      scheduler): the encoded payload rides ONE fused all-reduce thunk.
+      Measured on the XLA:CPU thunk runtime, every manual decomposition
+      only adds full-device rendezvous wall (exposed-comms ratios vs the
+      single thunk: ring 1.69x, concurrent 1.26x, concurrent with
+      narrowed containers 2.5x — each extra collective is a barrier and
+      each cast an extra memory pass there), so the in-collective wire
+      degenerates to the staged transport, by measurement not fiat."""
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return "ring"
+    if backend == "gpu":
+        return "concurrent"
+    return "single"
+
+
+#: int8-mode totals (and ring partial sums) fit int16 while
+#: W * 128 <= 2**15: legit contributions are clipped to +-127, and even
+#: a NaN-poisoned bucket's int8-wrapped garbage stays within +-128
+_INT16_TOTAL_WORLD = 255
+
+
+def _narrow_wire(buf):
+    """The true wire container for *pre-accumulation* payloads.
+    :func:`_encode` holds int8-grid values in int32 and e4m3-grid values
+    in f32 — the accumulator dtypes the staged psum needs in flight —
+    but a hop that carries UN-summed contributions can ship the one-byte
+    container the payload semantics promise.  Returns ``(sent, widen)``;
+    exact by the encode contract (ints clipped to the int8 grid, floats
+    produced by an e4m3 cast — a NaN-poisoned bucket wraps arbitrarily
+    but is masked to NaN by the non-finite amax flag on either path)."""
+    if buf.dtype == jnp.int32:
+        return buf.astype(jnp.int8), lambda g: g.astype(jnp.int32)
+    if buf.dtype == jnp.float32:
+        return (buf.astype(jnp.float8_e4m3fn),
+                lambda g: g.astype(jnp.float32))
+    return buf, (lambda g: g)
+
+
+def _narrow_total(buf, W):
+    """Container for summed int8-mode payloads: int16 while the wrap
+    bound holds (:data:`_INT16_TOTAL_WORLD`).  fp8 totals leave the
+    e4m3 grid, so f32 stays f32."""
+    if buf.dtype == jnp.int32 and W <= _INT16_TOTAL_WORLD:
+        return buf.astype(jnp.int16), lambda g: g.astype(jnp.int32)
+    return buf, (lambda g: g)
+
+
+def _canonical_zero(buf):
+    """Canonicalize the zero sign to psum's: XLA's all-reduce folds
+    contributions into a +0.0 identity accumulator, so a chunk whose
+    every contribution is -0.0 (fp8 underflow payloads) sums to +0.0
+    there, while a chained/treewise sum can keep -0.0.  (An explicit
+    +0.0 seed would express this, but the algebraic simplifier folds
+    x + 0.0 away; the select survives.)  No-op for integer payloads
+    and for NaN (NaN == 0 is False, so NaN passes through)."""
+    return jnp.where(buf == 0, jnp.zeros((), buf.dtype), buf)
+
+
+def _ring_reduce_scatter(own, axis):
+    """Exact ring reduce-scatter over named ``axis``: ``own`` is this
+    shard's (W, ...) per-chunk contribution; returns this shard's fully
+    reduced chunk, with ring position *i* ending up owning chunk *i* —
+    the same tiled assignment ``psum_scatter`` uses.  W-1 hops, each
+    carrying one chunk of encoded payload in the narrowed partial-sum
+    container (:func:`_narrow_total`); arrivals widen and accumulate in
+    the payload's accumulator dtype (int32 for int8, f32 for the fp8
+    grid), so the partial sums equal the staged psum's exactly."""
+    W = own.shape[0]
+    if W == 1:
+        return own[0]
+    perm = [(i, (i + 1) % W) for i in range(W)]
+    my = jax.lax.axis_index(axis)
+    buf = jnp.take(own, (my - 1) % W, axis=0)
+    for hop in range(W - 1):
+        sent, widen = _narrow_total(buf, W)  # partials fit the same bound
+        buf = widen(jax.lax.ppermute(sent, axis, perm))
+        buf = buf + jnp.take(own, (my - 2 - hop) % W, axis=0)
+    return _canonical_zero(buf)
+
+
+def _a2a_reduce_scatter(own, axis):
+    """Exact concurrent reduce-scatter: one all-to-all delivers every
+    peer's contribution to my chunk (all "hops" fire at once), then a
+    LOCAL sum over the peer dim reduces them — encoded bytes on the
+    wire, and the reduction itself is compute the compiler can overlap
+    instead of wall inside an opaque all-reduce thunk.  Same chunk
+    assignment and exact grid arithmetic as the ring form."""
+    W = own.shape[0]
+    if W == 1:
+        return own[0]
+    sent, widen = _narrow_wire(own)
+    got = jax.lax.all_to_all(sent, axis, split_axis=0, concat_axis=0)
+    return _canonical_zero(jnp.sum(widen(got), axis=0))
+
+
+def _reduce_scatter_chunks(own, axis, form: str | None = None):
+    """The fused transport's reduce-scatter over the (W, ...) per-chunk
+    contributions, form resolved per backend (``form`` overrides —
+    tests pin every form bit-exact on CPU).  The single-thunk form IS
+    the backend collective: ``psum_scatter`` over the peer dim — the
+    same tiled assignment and fold-into-identity accumulation as the
+    staged path."""
+    if form is None:
+        form = _form_default()
+    if form == "ring":
+        return _ring_reduce_scatter(own, axis)
+    if form == "concurrent":
+        return _a2a_reduce_scatter(own, axis)
+    return jax.lax.psum_scatter(own, axis, scatter_dimension=0, tiled=False)
+
+
+def _ring_all_gather(chunk, axis, W):
+    """Exact ring all-gather: ``chunk`` owned by ring position *i* at
+    index *i* circulates W-1 hops; every shard returns the identical
+    stacked (W, ...) array.  Pure data movement, bit-exact by
+    construction — the hops carry the already-reduced encoded totals."""
+    if W == 1:
+        return chunk[None]
+    perm = [(i, (i + 1) % W) for i in range(W)]
+    my = jax.lax.axis_index(axis)
+    sent, widen = _narrow_total(chunk, W)
+    out = jnp.zeros((W,) + sent.shape, sent.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(out, sent, my, 0)
+    buf = sent
+    for hop in range(W - 1):
+        buf = jax.lax.ppermute(buf, axis, perm)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, buf, (my - 1 - hop) % W, 0
+        )
+    return widen(out)
+
+
+def _all_gather_chunks(chunk, axis, W, form: str | None = None):
+    """The fused transport's all-gather: the ring form hop-pipelines
+    narrowed totals, the concurrent form is one native all-gather of
+    the narrowed container, the single-thunk form one native all-gather
+    as-is (casts are extra memory passes on a host backend).  Pure data
+    movement every way — peer-index stacking, the same (W, ...)
+    layout."""
+    if form is None:
+        form = _form_default()
+    if form == "ring":
+        return _ring_all_gather(chunk, axis, W)
+    if form == "concurrent":
+        sent, widen = _narrow_total(chunk, W)
+        return widen(jax.lax.all_gather(sent, axis, axis=0, tiled=False))
+    return jax.lax.all_gather(chunk, axis, axis=0, tiled=False)
+
+
+def _fused_allreduce(q, axis, W, form: str | None = None):
+    """In-collective all-reduce of an encoded payload: reduce-scatter of
+    the 8-bit-grid values then an all-gather of the reduced chunks, with
+    the manual forms shipping the NARROW container the payload semantics
+    promise (:func:`_narrow_wire` / :func:`_narrow_total`) — one
+    byte/elem for un-summed contributions, int16 for int8-mode totals —
+    where the staged ``psum`` must carry its int32/f32 accumulator in
+    flight.  Grid partial sums are exact, so the result is bit-identical
+    to ``jax.lax.psum(q, axis)`` — the staged transport — in every form
+    (:func:`_form_default`): the TPU ring carries one chunk per hop the
+    scheduler overlaps, the concurrent form fires the hops as one
+    all-to-all and hands the reduction to the compiler as schedulable
+    compute, and the single-thunk form rides the backend's own fused
+    reduce+transport collective."""
+    if W == 1:
+        return q
+    if form is None:
+        form = _form_default()
+    if form == "single":
+        return jax.lax.psum(q, (axis,))
+    shape = q.shape
+    size = int(np.prod(shape)) if shape else 1
+    chunk = -(-size // W)
+    flat = q.reshape(-1)
+    pad = W * chunk - size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), q.dtype)])
+    own = flat.reshape(W, chunk)
+    mine = _reduce_scatter_chunks(own, axis, form)
+    full = _all_gather_chunks(mine, axis, W, form).reshape(-1)
+    if pad:
+        full = full[:size]
+    return full.reshape(shape)
+
+
 # -- the in-shard_map sync ----------------------------------------------------
 
 
@@ -370,7 +621,12 @@ def sync_gradients(
     via the caller's health skip, or to zero here when EF is off for
     that bucket this step).
     """
+    from tpuframe.ops.quant_wire import (
+        bucket_abs_max, quant_decode, quant_encode,
+    )
+
     axes, world = layout.axes, layout.world
+    fused = fused_active(layout, config)
     ef = config.error_feedback and bool(comms)
     leaves = {
         path_str(p): leaf
@@ -425,27 +681,35 @@ def sync_gradients(
         amax_g: dict[tuple, Any] = {}
         enc_g: dict[tuple, Any] = {}
         for s, e in bounds:  # fire order: reverse-backward
-            amax_g[(s, e)] = _agreed_amax(
-                jnp.max(jnp.abs(v[s:e]), axis=1, keepdims=True), axes
-            )
+            amax_g[(s, e)] = _agreed_amax(bucket_abs_max(v[s:e]), axes)
         for s, e in bounds:
-            enc_g[(s, e)] = _encode(
-                v[s:e], amax_g[(s, e)], config, None,
-                noise=None if noise is None else noise[s:e],
+            sr = config.stochastic_rounding and config.mode != "fp8"
+            enc_g[(s, e)] = quant_encode(
+                v[s:e], amax_g[(s, e)], config.mode,
+                noise=noise[s:e] if (sr and noise is not None) else None,
             )
         total_g: dict[tuple, Any] = {}
         mean_seg: dict[tuple, Any] = {}
         resid_seg: dict[tuple, Any] = {}
 
         def _finish(se):
-            _q, deq = enc_g[se]
-            mean_g = total_g[se].astype(jnp.float32) * deq / world
-            # per-bucket non-finite propagation (matches exact psum)
-            mean_seg[se] = jnp.where(jnp.isfinite(amax_g[se]), mean_g, jnp.nan)
+            # dequant + mean + per-bucket non-finite propagation
+            # (matches exact psum), fused into one pass by quant_decode
+            mean_seg[se] = quant_decode(
+                total_g[se], amax_g[se], config.mode, world
+            )
 
         for i, (s, e) in enumerate(bounds):
             q, deq = enc_g[(s, e)]
-            total_g[(s, e)] = jax.lax.psum(q, axes)
+            # staged: one monolithic psum of the encoded payload.
+            # fused: the payload rides a manual ring — W-1 reduce-
+            # scatter hops + W-1 all-gather hops, each moving one
+            # compressed chunk with exact on-arrival accumulation —
+            # bit-identical totals, hop-granular overlap.
+            total_g[(s, e)] = (
+                _fused_allreduce(q, axes[0], world) if fused
+                else jax.lax.psum(q, axes)
+            )
             if ef:
                 resid = v[s:e] - q.astype(jnp.float32) * deq
                 resid_seg[(s, e)] = jnp.where(
@@ -496,9 +760,18 @@ def sync_gradients(
             bshape[dim] = shape[dim]
             amax_b = jnp.repeat(amax_c, chunk).reshape(bshape)
             q, deq_b = _encode(g, amax_b, config, subrng(tag + 1))
-            mine = jax.lax.psum_scatter(
-                q, axes, scatter_dimension=dim, tiled=True
-            )
+            # fused: in-collective reduce-scatter of the encoded chunks
+            # (position i ends owning chunk i — psum_scatter's tiled
+            # assignment), compressed bytes on the wire, exact
+            # accumulation; staged: one psum_scatter.
+            if fused:
+                mine = _reduce_scatter_chunks(
+                    jnp.stack(jnp.split(q, world, axis=dim)), axes[0]
+                )
+            else:
+                mine = jax.lax.psum_scatter(
+                    q, axes, scatter_dimension=dim, tiled=True
+                )
             # my chunk's dequant factor (scalar — one scale per chunk,
             # same denom _encode used for that chunk on every shard)
             grid = _FP8_MAX if config.mode == "fp8" else _QMAX
@@ -550,6 +823,8 @@ def wire_plan(layout: GradLayout, config: CommsConfig,
             "flat_elems": layout.flat_elems,
             "sliced_leaves": len(layout.sliced),
             "overlap_groups": layout.n_groups,
+            "fused": False,
+            "fused_hops": 0,
             "groups": [],
         }
     ar = 2.0 * (W - 1) / W   # all-reduce legs
@@ -592,6 +867,16 @@ def wire_plan(layout: GradLayout, config: CommsConfig,
         "flat_elems": layout.flat_elems,
         "sliced_leaves": len(layout.sliced),
         "overlap_groups": layout.n_groups,
+        # in-collective transport: bytes_per_step is INVARIANT under
+        # fusion — the ring all-reduce moves the same 2*(W-1)/W payload
+        # volume per participant the staged psum's ring does (this is
+        # the same accounting rule that keeps bytes invariant under
+        # grouping).  What fusion changes is hop granularity: 2*(W-1)
+        # compressed chunk hops per group the scheduler can overlap,
+        # recorded here as detail for the span/bench, never as a bytes
+        # delta.
+        "fused": fused_active(layout, config),
+        "fused_hops": 2 * (W - 1) if fused_active(layout, config) else 0,
         "groups": groups,
     }
 
@@ -614,6 +899,7 @@ def make_compressed_pmean(plan, config: CommsConfig | str = "int8"):
 
     if not isinstance(config, CommsConfig):
         config = CommsConfig(mode=config)
+    config = resolve_fused(plan, config)
     cache: dict[tuple, Any] = {}
 
     def call(tree: Any, residual: Mapping[str, Any] | None = None):
@@ -656,8 +942,19 @@ def make_compressed_pmean(plan, config: CommsConfig | str = "int8"):
         t0 = time.perf_counter()
         with tele.span("comms/allreduce", mode=config.mode,
                        bytes=plan_bytes["bytes_per_step"]):
-            out, new_resid = fn(tree, residual)
-            jax.block_until_ready(out)
+            if plan_bytes.get("fused"):
+                # the fused transport's own span: one per call (the
+                # hops live inside one jitted program — host code can't
+                # bracket them individually), hop count as the attr
+                with tele.span("comms/fused_hop",
+                               hops=plan_bytes["fused_hops"],
+                               world=plan_bytes["world"],
+                               mode=config.mode):
+                    out, new_resid = fn(tree, residual)
+                    jax.block_until_ready(out)
+            else:
+                out, new_resid = fn(tree, residual)
+                jax.block_until_ready(out)
         tele.registry.histogram("comms/allreduce_s").observe(
             time.perf_counter() - t0
         )

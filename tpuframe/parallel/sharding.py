@@ -151,6 +151,13 @@ class ParallelPlan:
     #: an explicit value pins the schedule on the plan so it rides the
     #: plan signature, the topology manifest, and the compile labels.
     comms_groups: int | None = None
+    #: in-collective compressed transport (see
+    #: ``parallel.compression.fused_active``): None defers to
+    #: ``CommsConfig.fused`` (the ``TPUFRAME_COMMS_FUSED`` env knob);
+    #: an explicit bool pins the transport on the plan so it rides the
+    #: plan signature and the AOT compile labels — a fused and a staged
+    #: program are different programs.
+    comms_fused: bool | None = None
 
     def __post_init__(self):
         if self.zero_stage not in (0, 1, 2, 3):
@@ -158,6 +165,10 @@ class ParallelPlan:
         if self.comms_groups is not None and self.comms_groups < 1:
             raise ValueError(
                 f"comms_groups must be >= 1 (or None), got {self.comms_groups}"
+            )
+        if self.comms_fused not in (None, True, False):
+            raise ValueError(
+                f"comms_fused must be a bool or None, got {self.comms_fused!r}"
             )
         if self.offload_optimizer and not host_memory_available(self.mesh):
             # loud, not silent: a user who asked for DeepSpeed-style CPU
@@ -201,6 +212,11 @@ class ParallelPlan:
         # labels — is unchanged by the field's existence
         if self.comms_groups is not None and self.comms_groups != 1:
             payload["comms_groups"] = int(self.comms_groups)
+        # same omit-the-default rule for the fused transport: only a
+        # pinned True changes the program identity (pinned False is the
+        # staged program every pre-existing signature already names)
+        if self.comms_fused:
+            payload["comms_fused"] = True
         blob = json.dumps(payload, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:12]
 
@@ -216,10 +232,15 @@ class ParallelPlan:
         groups = self.comms_groups
         if groups is None:
             groups = int(getattr(config, "groups", 1) or 1)
+        fused = self.comms_fused
+        if fused is None:
+            fused = bool(getattr(config, "fused", False))
         return {
             "groups": int(groups),
             "order": "reverse_backward",
             "pinned": self.comms_groups is not None,
+            "fused": bool(fused),
+            "fused_pinned": self.comms_fused is not None,
         }
 
     def describe_topology(self) -> dict:

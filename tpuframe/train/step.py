@@ -373,6 +373,7 @@ def _make_compressed_train_step(
         CommsConfig,
         comms_template,
         grad_layout,
+        resolve_fused,
         sync_gradients,
         wire_plan,
     )
@@ -382,6 +383,10 @@ def _make_compressed_train_step(
     assert config is not None  # caller checked grad_compression truthy
     if plan is None:
         raise ValueError("grad_compression needs a plan (its mesh and data axes)")
+    # a pinned plan.comms_fused wins over the TPUFRAME_COMMS_FUSED env
+    # (plan-first, like comms_groups); the resolved flag rides the plan
+    # signature, so fused and staged programs get distinct AOT keys
+    config = resolve_fused(plan, config)
     if plan.zero_stage == 3 or plan.rules:
         raise ValueError(
             "grad_compression composes with DP and ZeRO-1/2 (replicated "

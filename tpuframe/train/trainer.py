@@ -553,6 +553,11 @@ class Trainer:
         tele.registry.counter("comms/bytes_on_wire").inc(
             wire["bytes_per_step"]
         )
+        if wire.get("fused"):
+            # steps whose sync rode the in-collective (fused ring)
+            # transport — bytes are invariant under fusion, so this
+            # counter is how dashboards tell the transports apart
+            tele.registry.counter("comms/fused_steps").inc()
 
     # -- preemption ----------------------------------------------------------
     def _preempt_watcher(self):
