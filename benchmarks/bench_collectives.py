@@ -60,12 +60,27 @@ arm off a parsed capture.  The committed record carries analyzer-
 gateable ``step_time`` + ``comms`` + ``device_time`` blocks
 (``ratio_p50`` / ``ratio_bytes_on_wire`` / ``ratio_exposed_comms``).
 
+``--pipeline`` runs the schedule A/B the composed-parallelism plan pins
+(``plan.pp_schedule``): the SAME pipelined-LM fit on a pipe x data mesh
+with the ``interleaved`` schedule (``ppermute`` hops free to slot
+between stage compute) vs ``barriered`` (an ``optimization_barrier``
+pins every hop to its tick boundary — the serialized baseline).  Every
+schedule computes identical values, so the single-apply logits are
+compared bit-for-bit across arms; both arms AOT-compiled (zero
+``compile/recompile``/``aot_fallback`` committed), exposed comms
+measured per arm off a parsed capture.  The committed record carries
+analyzer-gateable ``step_time`` + ``device_time`` blocks
+(``ratio_p50`` / ``ratio_exposed_comms``), with the interleaved arm's
+capture as the top-level ``device_time`` baseline anchor.
+
 Usage: python benchmarks/bench_collectives.py [--payload-mb 8]
            [--iters 30] [--steps 30] [--json-only]
        python benchmarks/bench_collectives.py --overlap
            [--overlap-groups 4] [--overlap-steps 12] [--overlap-width 768]
        python benchmarks/bench_collectives.py --fused
            [--overlap-steps 12] [--overlap-width 768] [--bucket-mb 4]
+       python benchmarks/bench_collectives.py --pipeline
+           [--pipeline-steps 12] [--pipeline-microbatches 8]
 """
 
 from __future__ import annotations
@@ -678,6 +693,221 @@ def run_fused(args) -> int:
     return 0 if ok else 4
 
 
+def run_pipeline(args) -> int:
+    """The pipeline-schedule A/B: interleaved hop/compute vs barriered
+    hop-then-compute on a pipe x data mesh, same composed plan shape,
+    same model, same batches, same seeds — exposed comms measured off a
+    parsed profiler capture per arm, single-apply logits compared
+    bit-for-bit across schedules."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpuframe.compile.precompile import (
+        ShapeGuard,
+        abstract_state,
+        batch_signature,
+        precompile_call,
+    )
+    from tpuframe.core import runtime as rt
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.parallel import PipelinedTransformerLM
+    from tpuframe.parallel.compose import compose
+    from tpuframe.track.device_time import device_time_report
+    from tpuframe.track.profiler import trace
+    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.train import create_train_state, make_train_step
+
+    n_steps = int(args.pipeline_steps)
+    n_micro = int(args.pipeline_microbatches)
+    warmup = 3
+    vocab, layers, heads, head_dim, seq = 256, 4, 4, 32, 128
+    batch = 16
+
+    # the pipelined LM reads its stage count from the process runtime
+    rt.reset_runtime()
+    runtime = rt.initialize(MeshSpec(pipe=4, data=-1))
+    world = runtime.device_count
+    tele = get_telemetry()
+
+    def mk_plan(schedule):
+        return compose(
+            mesh=runtime.mesh, pp=4, microbatches=n_micro,
+            schedule=schedule, min_shard_elems=1024,
+        )
+
+    def mk_model(plan):
+        return PipelinedTransformerLM(
+            vocab_size=vocab, num_layers=layers, num_heads=heads,
+            head_dim=head_dim, max_len=seq,
+            n_microbatches=plan.pp_microbatches, schedule=plan.pp_schedule,
+        )
+
+    def mk_state(plan):
+        return create_train_state(
+            mk_model(plan), jax.random.PRNGKey(0),
+            jnp.zeros((1, seq), jnp.int32), optax.adamw(1e-3), plan=plan,
+        )
+
+    def mk_batches(plan, n):
+        r = np.random.default_rng(7)
+        out = []
+        for _ in range(n):
+            toks = r.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+            out.append(plan.shard_batch(
+                {"input": toks[:, :-1], "label": toks[:, 1:]}
+            ))
+        return out
+
+    def bits_equal(a, b) -> bool:
+        la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+        return len(la) == len(lb) and all(
+            np.asarray(x).tobytes() == np.asarray(y).tobytes()
+            for x, y in zip(la, lb)
+        )
+
+    # the bit-exactness contract is on the SCHEDULE: every schedule
+    # computes the identical values (barriered only constrains ordering),
+    # so one forward apply must agree bit-for-bit across arms.  Runs
+    # BEFORE the fits: the train step donates its state.
+    plan_i, plan_b = mk_plan("interleaved"), mk_plan("barriered")
+    probe = mk_state(plan_i)
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(0, vocab, (batch, seq)), jnp.int32
+    )
+    logits_i = mk_model(plan_i).apply({"params": probe.params}, toks)
+    logits_b = mk_model(plan_b).apply({"params": probe.params}, toks)
+    bit_exact = bits_equal(logits_i, logits_b)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(probe.params))
+    del probe, logits_i, logits_b
+
+    def run_arm(plan) -> dict:
+        schedule = plan.pp_schedule
+        step = make_train_step(plan=plan)
+        state = mk_state(plan)
+        batches = mk_batches(plan, warmup + n_steps)
+        recompiles0 = tele.registry.counter("compile/recompiles").value
+        compiled = precompile_call(
+            step, (abstract_state(state), batches[0]),
+            label=f"bench/pipeline@{schedule}",
+        )
+        guard = ShapeGuard(tele)
+        guard.expect("train", batch_signature(batches[0]))
+        fallbacks = 0
+
+        def dispatch(state, batch):
+            nonlocal fallbacks
+            guard.check("train", batch_signature(batch))
+            if compiled is not None:
+                try:
+                    return compiled(state, batch)
+                except Exception as e:
+                    fallbacks += 1
+                    tele.event(
+                        "compile/aot_fallback", step_kind="train",
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
+            return step(state, batch)
+
+        for b in batches[:warmup]:
+            state, metrics = dispatch(state, b)
+            jax.block_until_ready(metrics)
+        walls = []
+        logdir = tempfile.mkdtemp(prefix=f"tpuframe_pipeline_{schedule}_")
+        with trace(logdir):
+            for b in batches[warmup:]:
+                t0 = time.perf_counter()
+                state, metrics = dispatch(state, b)
+                jax.block_until_ready(metrics)
+                walls.append(time.perf_counter() - t0)
+            jax.block_until_ready(state)
+        dt = device_time_report(logdir, steps=n_steps) or {}
+        dt["trace_dir"] = None  # temp dir: gone by the time anyone reads this
+        shutil_rmtree(logdir)
+        return {
+            "schedule": schedule,
+            "state": state,
+            "device_time": dt,
+            "step_p50_s": round(statistics.median(sorted(walls)), 6),
+            "recompile_events": int(
+                tele.registry.counter("compile/recompiles").value
+                - recompiles0
+            ),
+            "aot_fallback_events": fallbacks,
+            "aot_dispatch": compiled is not None,
+        }
+
+    inter = run_arm(plan_i)
+    barr = run_arm(plan_b)
+    params_drift = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree.leaves(inter["state"].params),
+            jax.tree.leaves(barr["state"].params),
+        )
+    )
+
+    def arm_rec(arm: dict) -> dict:
+        dt = arm["device_time"]
+        return {
+            "schedule": arm["schedule"],
+            "step_p50_s": arm["step_p50_s"],
+            "exposed_comms_per_step_s": dt.get("exposed_comms_per_step_s"),
+            "overlap_efficiency": dt.get("overlap_efficiency"),
+            "collective_wall_s": (
+                (dt.get("classes") or {}).get("collective") or {}
+            ).get("wall_s"),
+            "recompile_events": arm["recompile_events"],
+            "aot_fallback_events": arm["aot_fallback_events"],
+            "aot_dispatch": arm["aot_dispatch"],
+        }
+
+    ie = inter["device_time"].get("exposed_comms_per_step_s") or 0.0
+    be = barr["device_time"].get("exposed_comms_per_step_s") or 0.0
+    rec = {
+        "benchmark": "pipeline_schedule",
+        "backend": jax.default_backend(),
+        "world": world,
+        "topology": {"pipe": 4, "data": world // 4},
+        "model": {
+            "vocab": vocab, "layers": layers, "d_model": heads * head_dim,
+            "seq_len": seq, "microbatches": n_micro,
+            "params_mb": round(n_params * 4 / (1 << 20), 3),
+        },
+        "steps_per_arm": n_steps,
+        "pipeline": {
+            "interleaved": arm_rec(inter),
+            "barriered": arm_rec(barr),
+            "bit_exact_logits": bit_exact,
+            "final_params_max_abs_diff": params_drift,
+            "exposed_reduction_x": (
+                round(be / ie, 3) if be and ie else None
+            ),
+        },
+        # the fleet step-time baseline block (ratio_p50): the
+        # interleaved arm IS the configuration this record recommends
+        "step_time": {
+            "p50_s": inter["step_p50_s"],
+            "barriered_p50_s": barr["step_p50_s"],
+            "steps": n_steps,
+        },
+        # the analyzer's ratio_exposed_comms baseline anchor
+        "device_time": inter["device_time"],
+    }
+    print(json.dumps(rec, indent=1))
+    ok = (
+        bit_exact
+        and inter["recompile_events"] == 0
+        and inter["aot_fallback_events"] == 0
+        and barr["recompile_events"] == 0
+        and barr["aot_fallback_events"] == 0
+    )
+    return 0 if ok else 4
+
+
 def shutil_rmtree(path: str) -> None:
     import shutil
 
@@ -702,6 +932,10 @@ def main() -> int:
                     help="per-device samples per microbatch per overlap step")
     ap.add_argument("--overlap-accum", type=int, default=4,
                     help="microbatches per overlap step (1 = plain step)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run the pipeline-schedule A/B instead")
+    ap.add_argument("--pipeline-steps", type=int, default=12)
+    ap.add_argument("--pipeline-microbatches", type=int, default=8)
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
@@ -716,6 +950,8 @@ def main() -> int:
         return run_overlap(args)
     if args.fused:
         return run_fused(args)
+    if args.pipeline:
+        return run_pipeline(args)
 
     import jax
     import jax.numpy as jnp

@@ -251,6 +251,18 @@ run bench_overlap.json        600  python benchmarks/bench_collectives.py \
 run bench_fused.json          600  python benchmarks/bench_collectives.py \
   --fused
 
+# pipeline-schedule rung: the composed plan's `pp_schedule` A/B
+# (interleaved hop-under-compute vs barriered hop-then-compute) through
+# the REAL pipelined-LM train step on a pipe x data mesh — schedules
+# must be bit-exact on logits (the gpipe contract) with zero
+# recompile/aot_fallback per arm, and the committed top-level
+# `device_time` block (interleaved arm) is what `track analyze
+# --baseline` gates ratio_exposed_comms against (exit 3).  On the TPU
+# host this is where the interleaved hop actually hides under stage
+# compute instead of the CPU's serialized collective-permute
+run bench_pipeline.json       600  python benchmarks/bench_collectives.py \
+  --pipeline
+
 # compile-spine rung: cold vs warm-cache vs AOT-overlapped
 # time-to-first-step on the real chip — the committed
 # time_to_first_step block is what `track analyze --baseline` gates

@@ -22,8 +22,23 @@ block's MLP for a top-k gated MoE with expert weights sharded over the
 would (SURVEY.md §4: simulated-multidevice testing is the TPU-world
 answer to "test multi-node without a cluster").
 
+``--composed`` switches to the composed N-D parallelism acceptance
+story (ISSUE 18): one :func:`tpuframe.parallel.compose.compose` call
+declares the whole plan, and the run survives a chaos kill AND a *plan*
+change across the restart —
+
+- phase 1: DP(fsdp) x ZeRO-1 x TP=2 x PP=2 pipelined-LM pretrain,
+  AOT-precompiled, chaos-killed mid-run at a scheduled step;
+- phase 2: the same checkpoint directory resumed under a different
+  composed plan (DP x fsdp ZeRO-3 + int8 compressed wire + composed
+  grad-clip) — the restore reshards across the plan change (exactly one
+  ``fault/reshard``) and training completes the full step count with
+  zero ``compile/recompile`` / ``compile/aot_fallback`` events.
+
 Run:  python 06_lm_sequence_parallel.py --attn ulysses --seq-len 512 \
           --simulate-devices 8
+      python 06_lm_sequence_parallel.py --composed --simulate-devices 8 \
+          --batch-size 16 --train-samples 48 --seq-len 64 --heads 4
 """
 
 from __future__ import annotations
@@ -128,6 +143,137 @@ def train(args) -> dict:
     return history[-1]
 
 
+class NextTokenDataset(SyntheticTokenDataset):
+    """(input, label) next-token pairs in the (x, y) shape DataLoader
+    and the Trainer's generic batch path expect."""
+
+    def __getitem__(self, i: int):
+        toks = super().__getitem__(i)
+        return toks[:-1], toks[1:]
+
+
+def train_composed(args) -> dict:
+    """The composed N-D story: chaos-kill under TP x PP, resume under a
+    DIFFERENT composed plan, finish the full schedule."""
+    import os
+
+    from tpuframe.ckpt import Checkpointer
+    from tpuframe.core import runtime as rt
+    from tpuframe.core.runtime import MeshSpec
+    from tpuframe.data import DataLoader
+    from tpuframe.fault import ChaosError, ChaosPlan, RaiseAt
+    from tpuframe.parallel import PipelinedTransformerLM
+    from tpuframe.parallel.compose import compose
+    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.train import Trainer
+
+    steps_per_epoch = args.train_samples // args.batch_size
+    total_steps = args.epochs * steps_per_epoch
+    # kill after at least one mid-epoch snapshot (interval 2) exists,
+    # with work left for the resumed plan to prove it actually trains
+    kill_step = args.kill_step if args.kill_step else max(2, total_steps - 2)
+    if total_steps < 4:
+        raise ValueError(
+            f"--composed needs >= 4 total steps to kill and resume "
+            f"(got {total_steps}; raise --train-samples or --epochs)"
+        )
+
+    tele = get_telemetry()
+    tele.event("test/mark", token="composed-story")
+
+    def lm(plan):
+        return PipelinedTransformerLM(
+            vocab_size=args.vocab, num_layers=args.layers,
+            num_heads=args.heads, head_dim=args.head_dim,
+            max_len=args.seq_len,
+            # the plan's schedule pins thread into the model so the
+            # program the signature names is the program that runs
+            n_microbatches=plan.pp_microbatches,
+            schedule=plan.pp_schedule,
+        )
+
+    def loader():
+        ds = NextTokenDataset(args.train_samples, args.seq_len, args.vocab,
+                              seed=args.seed)
+        return DataLoader(ds, args.batch_size, shuffle=True, seed=args.seed,
+                          drop_last=True)
+
+    ckpt_dir = os.path.join(args.workdir, "composed_ck")
+
+    # -- phase 1: DP(fsdp) x ZeRO-1 x TP=2 x PP=2, killed mid-run ---------
+    rt.reset_runtime()
+    runtime = rt.initialize(MeshSpec(pipe=2, fsdp=2, model=2))
+    plan1 = compose(
+        mesh=runtime.mesh, tp=2, pp=2, fsdp=2, zero_stage=1,
+        microbatches=args.pp_microbatches or None, schedule=args.pp_schedule,
+        min_shard_elems=1024,
+    )
+    killed_at = None
+    with Checkpointer(ckpt_dir) as ck:
+        trainer = Trainer(
+            lm(plan1),
+            train_dataloader=loader(),
+            max_duration=f"{args.epochs}ep",
+            plan=plan1, lr=args.lr, seed=args.seed,
+            checkpointer=ck, checkpoint_interval_batches=2,
+            eval_interval=0, log_interval=0,
+        )
+        try:
+            with ChaosPlan([RaiseAt("step", step=kill_step)]).active():
+                trainer.fit()
+        except ChaosError:
+            killed_at = trainer.batches_seen
+    assert killed_at is not None, "chaos kill never fired"
+    print(f"phase 1 (tp=2 pp=2 zero=1, schedule={plan1.pp_schedule}): "
+          f"chaos-killed at step {killed_at}/{total_steps}", flush=True)
+
+    # -- phase 2: SAME checkpoints, DIFFERENT plan ------------------------
+    # DP x fsdp ZeRO-3 with the int8 compressed wire and the composed
+    # (plan-global-norm) grad clip — no TP, no pipeline: the restore must
+    # reshard every param/opt leaf across the plan change
+    rt.reset_runtime()
+    runtime = rt.initialize(MeshSpec(data=2, fsdp=4))
+    plan2 = compose(
+        mesh=runtime.mesh, dp=2, fsdp=4, zero_stage=3, min_shard_elems=1024,
+    )
+    with Checkpointer(ckpt_dir) as ck:
+        trainer = Trainer(
+            lm(plan2),
+            train_dataloader=loader(),
+            max_duration=f"{args.epochs}ep",
+            plan=plan2, lr=args.lr, seed=args.seed,
+            checkpointer=ck, checkpoint_interval_batches=2,
+            eval_interval=0, log_interval=0,
+            grad_compression="int8", grad_clip=1.0,
+        )
+        result = trainer.fit()
+    final_loss = float(result.metrics.get("train_loss", float("nan")))
+
+    # -- the acceptance ledger -------------------------------------------
+    events = tele.recent_events(10**6)
+    idx = max(i for i, e in enumerate(events)
+              if e.get("name") == "test/mark"
+              and e.get("token") == "composed-story")
+    since = events[idx + 1:]
+    reshards = [e for e in since if e.get("name") == "fault/reshard"]
+    recompiles = [e for e in since if e.get("name") == "compile/recompile"]
+    fallbacks = [e for e in since if e.get("name") == "compile/aot_fallback"]
+    assert trainer.batches_seen == total_steps, (
+        f"resumed run stopped at {trainer.batches_seen}/{total_steps}"
+    )
+    assert len(reshards) == 1, f"expected exactly one reshard, got {reshards}"
+    assert reshards[0]["to_plan"] == plan2.signature()
+    assert not recompiles and not fallbacks, (recompiles, fallbacks)
+    assert np.isfinite(final_loss)
+    print(f"phase 2 (dp=2 fsdp=4 zero=3 int8 clip): resumed across the "
+          f"plan change, loss {final_loss:.4f}", flush=True)
+    print(f"composed story: steps {trainer.batches_seen}/{total_steps} "
+          f"reshards={len(reshards)} recompiles={len(recompiles)} "
+          f"aot_fallbacks={len(fallbacks)}", flush=True)
+    return {"train_loss": final_loss, "steps": trainer.batches_seen,
+            "reshards": len(reshards)}
+
+
 def main(argv=None):
     p = base_parser("Long-context LM with ring/Ulysses sequence parallelism")
     p.add_argument("--attn", default="ring",
@@ -141,12 +287,21 @@ def main(argv=None):
     p.add_argument("--zero-stage", type=int, default=1)
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--expert-shards", type=int, default=2)
+    p.add_argument("--composed", action="store_true",
+                   help="run the composed TP x PP -> plan-change resume story")
+    p.add_argument("--pp-schedule", default=None,
+                   help="pipeline schedule pin for --composed "
+                        "(interleaved/barriered/1f1b; default: env)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="pipeline microbatch pin for --composed (0: env)")
+    p.add_argument("--kill-step", type=int, default=0,
+                   help="chaos-kill step for --composed (0: auto)")
     args = p.parse_args(argv)
     if args.simulate_devices:
         from tpuframe.core.runtime import simulate_cpu_devices
 
         simulate_cpu_devices(args.simulate_devices)
-    final = train(args)
+    final = train_composed(args) if args.composed else train(args)
     assert np.isfinite(final["train_loss"])
     return final
 

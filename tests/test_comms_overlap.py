@@ -274,7 +274,8 @@ class TestScheduleArtifact:
         sched = ParallelPlan(mesh=mesh).comms_schedule()
         assert sched == {
             "groups": 1, "order": "reverse_backward", "pinned": False,
-            "fused": False, "fused_pinned": False}
+            "fused": False, "fused_pinned": False,
+            "pp_schedule": "interleaved", "pp_pinned": False}
         # env/config default fills in when the plan doesn't pin...
         sched = ParallelPlan(mesh=mesh).comms_schedule(
             CommsConfig(mode="int8", groups=3))

@@ -1,0 +1,126 @@
+"""Plan composition (tpuframe.parallel.compose): one declaration ->
+one ParallelPlan for DP x ZeRO x TP x PP x SP, with derived sharding
+rules, env-resolved pipeline pins riding the plan signature, loud
+dimension mismatches, and the parallel/compose audit event."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.core.runtime import MeshSpec
+from tpuframe.parallel import ParallelPlan
+from tpuframe.parallel.compose import compose, default_tp_rules, pipeline_rules
+from tpuframe.parallel.comms_env import (
+    COMMS_ENV_DOMAINS,
+    COMMS_ENV_VARS,
+    PP_SCHEDULE_CHOICES,
+    pp_microbatches,
+    pp_schedule,
+    tp_size,
+)
+from tpuframe.track.telemetry import get_telemetry
+
+
+class TestCompose:
+    def test_dp_only_matches_hand_built_plan(self):
+        """compose() with defaults IS the plain DP plan: same mesh
+        shape, same signature — pre-existing autotune keys, manifests,
+        and compile labels must not move."""
+        plan = compose()
+        base = ParallelPlan(mesh=MeshSpec(data=-1).build())
+        assert plan.signature() == base.signature()
+        assert plan.pp_microbatches is None and plan.pp_schedule is None
+
+    def test_nd_composition_builds_the_declared_mesh(self):
+        plan = compose(tp=2, pp=2, zero_stage=3, microbatches=8)
+        topo = plan.describe_topology()
+        assert topo["pipeline_stages"] == 2
+        assert topo["tp_size"] == 2
+        assert topo["zero_stage"] == 3
+        assert plan.pp_microbatches == 8
+        # derived rules: vocab-parallel TP pair + the stage rule
+        assert plan.rules == default_tp_rules() + pipeline_rules()
+
+    def test_pp_pins_ride_the_signature(self):
+        a = compose(pp=2, microbatches=4)
+        b = compose(pp=2, microbatches=8)
+        c = compose(pp=2, microbatches=4, schedule="barriered")
+        assert a.signature() != b.signature()
+        assert a.signature() != c.signature()
+        # pp=1 keeps the None defaults: schedule/microbatch knobs can't
+        # perturb non-pipeline signatures
+        d = compose(microbatches=8)
+        assert d.pp_microbatches is None and d.pp_schedule is None
+
+    def test_mesh_dimension_mismatch_is_loud(self):
+        mesh = MeshSpec(data=-1).build()
+        with pytest.raises(
+            ValueError, match="composed dimensions disagree with the mesh"
+        ):
+            compose(mesh=mesh, tp=4)
+
+    def test_user_rules_win_over_derived(self):
+        mine = (r"embed_head/embed/embedding$", P(None, "model"))
+        plan = compose(tp=2, pp=2, rules=(mine,))
+        # first match wins: the caller's transposed placement overrides
+        # the derived vocab-parallel default for the same leaf
+        assert plan.param_spec("embed_head/embed/embedding", (64, 16)) == P(
+            None, "model"
+        )
+
+    def test_compose_event_carries_signature(self):
+        tele = get_telemetry()
+        tele.event("test/mark", token="compose-ev")
+        plan = compose(tp=2, pp=2)
+        events = tele.recent_events(200)
+        idx = max(
+            i for i, e in enumerate(events)
+            if e.get("name") == "test/mark" and e.get("token") == "compose-ev"
+        )
+        ev = [e for e in events[idx:] if e.get("name") == "parallel/compose"]
+        assert ev and ev[-1]["signature"] == plan.signature()
+        assert ev[-1]["tp"] == 2 and ev[-1]["pp"] == 2
+
+    def test_rebind_carries_pipeline_pins(self):
+        plan = compose(pp=2, microbatches=4, schedule="1f1b")
+        small = plan.rebind(MeshSpec(pipe=2, data=2).build(jax.devices()[:4]))
+        assert small.pp_microbatches == 4 and small.pp_schedule == "1f1b"
+
+    def test_plan_validates_pp_fields(self):
+        mesh = MeshSpec(data=-1).build()
+        with pytest.raises(ValueError, match="pp_microbatches"):
+            ParallelPlan(mesh=mesh, pp_microbatches=0)
+        with pytest.raises(ValueError, match="pp_schedule"):
+            ParallelPlan(mesh=mesh, pp_schedule="gpipe")
+
+
+class TestKnobs:
+    def test_registry_rows(self):
+        for knob in ("TPUFRAME_PP_MICROBATCHES", "TPUFRAME_PP_SCHEDULE",
+                     "TPUFRAME_TP_SIZE"):
+            assert knob in COMMS_ENV_VARS
+            assert knob in COMMS_ENV_DOMAINS
+
+    def test_env_resolution_into_compose(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_PP_MICROBATCHES", "16")
+        monkeypatch.setenv("TPUFRAME_PP_SCHEDULE", "barriered")
+        plan = compose(pp=2)
+        assert plan.pp_microbatches == 16
+        assert plan.pp_schedule == "barriered"
+
+    def test_readers_are_tolerant(self):
+        assert pp_microbatches({"TPUFRAME_PP_MICROBATCHES": "junk"}) == 0
+        assert pp_microbatches({"TPUFRAME_PP_MICROBATCHES": "999999"}) == 4096
+        assert pp_schedule({"TPUFRAME_PP_SCHEDULE": "nope"}) == "interleaved"
+        assert pp_schedule({}) == "interleaved"
+        assert tp_size({"TPUFRAME_TP_SIZE": "0"}) == 1
+        assert tp_size({"TPUFRAME_TP_SIZE": "4"}) == 4
+        assert set(PP_SCHEDULE_CHOICES) == {"interleaved", "barriered", "1f1b"}
+
+    def test_tp_env_fills_compose_default(self, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_TP_SIZE", "2")
+        plan = compose(pp=2)
+        assert plan.describe_topology()["tp_size"] == 2
+        # explicit tp= wins over the env
+        plan = compose(tp=1, pp=2)
+        assert plan.describe_topology()["tp_size"] == 1

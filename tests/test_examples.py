@@ -136,6 +136,22 @@ def test_vit_classifier_with_tp(tmp_path):
     assert "tp=2" in out
 
 
+def test_lm_composed_plan_change_story(tmp_path):
+    # ISSUE-18 acceptance: TP=2 x PP=2 x ZeRO-1 fit chaos-killed mid-run,
+    # resumed from the same checkpoints under DP x fsdp ZeRO-3 + int8 —
+    # one reshard, full step count, zero recompiles/AOT fallbacks
+    out = run_example(
+        "06_lm_sequence_parallel.py",
+        "--composed", "--simulate-devices", "8",
+        "--epochs", "2",  # overrides SMOKE's 1: the story needs >= 4 steps
+        "--seq-len", "64", "--heads", "4", "--layers", "2",
+        tmp_path=tmp_path,
+    )
+    assert "chaos-killed at step" in out
+    assert "resumed across the plan change" in out
+    assert "steps 6/6 reshards=1 recompiles=0 aot_fallbacks=0" in out
+
+
 def test_lm_moe_sequence_parallel(tmp_path):
     # SP + MoE blocks (2 devices only fit one sharded axis: seq here)
     out = run_example(

@@ -1,0 +1,155 @@
+"""Checkpoints portable across PLAN changes (not just world sizes): a
+TP=4 run's checkpoint restores bit-exact under TP=2 x PP=2 and under
+plain DP — the reshard boundary is exactly one loud ``fault/reshard``
+event carrying both plan signatures, and a *logical* mismatch (a
+different model) still refuses before any data is read.
+
+This is the checkpoint half of the ISSUE-18 composition tentpole: every
+plan here comes out of :func:`tpuframe.parallel.compose.compose`, so the
+derived TP/pipeline rules (vocab-parallel embed/head on ``model``,
+layer-stacked blocks on ``pipe``) are exactly what the manifests record
+and what the restore reshards between."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.ckpt import Checkpointer
+from tpuframe.parallel import PipelinedTransformerLM
+from tpuframe.parallel.compose import compose
+from tpuframe.track.telemetry import get_telemetry
+from tpuframe.train import create_train_state
+
+_MARKS = iter(range(1, 1 << 30))
+
+
+def _mark() -> str:
+    token = f"plan-change-{next(_MARKS)}"
+    get_telemetry().event("test/mark", token=token)
+    return token
+
+
+def _events_since(token: str, name: str | None = None) -> list[dict]:
+    ev = get_telemetry().recent_events(10**6)
+    idx = max(
+        i for i, e in enumerate(ev)
+        if e.get("name") == "test/mark" and e.get("token") == token
+    )
+    return [e for e in ev[idx + 1:] if name is None or e.get("name") == name]
+
+
+def _lm(vocab: int = 64):
+    # num_layers=2 divides the pipe=2 target; embed (64x16) and lm_head
+    # (16x64) divide cleanly by tp=4 AND tp=2, so every plan here shards
+    # them differently — the reshard has real work on every leaf class
+    return PipelinedTransformerLM(
+        vocab_size=vocab, num_layers=2, num_heads=2, head_dim=8,
+        max_len=32, n_microbatches=2,
+    )
+
+
+def _state(plan, vocab: int = 64, seed: int = 0):
+    return create_train_state(
+        _lm(vocab), jax.random.PRNGKey(seed),
+        jnp.zeros((1, 16), jnp.int32), optax.adam(1e-3), plan=plan,
+    )
+
+
+def _host_tree(tree):
+    # copy=True: CPU device_get can return a zero-copy view of the XLA
+    # buffer, and later donating steps would overwrite the "snapshot"
+    return jax.tree.map(lambda x: np.array(jax.device_get(x), copy=True), tree)
+
+
+def _assert_trees_bit_exact(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _leaf_axes(state, path_fragment: str) -> set:
+    """Mesh axes actually named by the sharding of the first param leaf
+    whose path contains ``path_fragment``."""
+    from tpuframe.parallel.sharding import path_str
+
+    for p, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        if path_fragment in path_str(p):
+            spec = leaf.sharding.spec
+            return {
+                a for e in spec if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))
+            }
+    raise AssertionError(f"no param leaf matching {path_fragment!r}")
+
+
+class TestPlanChangeRestore:
+    @pytest.mark.parametrize(
+        "target_kw, check_axes",
+        [
+            # TP=4 -> TP=2 x PP=2: embed re-splits model 4-way -> 2-way,
+            # blocks go replicated -> pipe-sharded
+            (dict(tp=2, pp=2), {"embed": {"model"}, "blocks": {"pipe"}}),
+            # TP=4 -> plain DP: every param lands fully replicated
+            (dict(), {"embed": set(), "blocks": set()}),
+        ],
+        ids=["tp2xpp2", "dp_only"],
+    )
+    def test_tp4_checkpoint_restores_across_plan_change(
+        self, tmp_path, target_kw, check_axes
+    ):
+        plan4 = compose(tp=4)
+        state = _state(plan4)
+        assert _leaf_axes(state, "embed_head/embed") == {"model"}
+        ref = _host_tree({"params": state.params, "opt": state.opt_state})
+        d = str(tmp_path / "ck")
+        with Checkpointer(d) as ck:
+            ck.save(state, step=5, plan=plan4)
+            ck.wait()
+            target = compose(**target_kw)
+            # different seed: the restore must overwrite every leaf
+            template = _state(target, seed=9)
+            n0 = _mark()
+            restored, _ = ck.restore(template, plan=target)
+        got = _host_tree({"params": restored.params, "opt": restored.opt_state})
+        _assert_trees_bit_exact(ref, got)
+        # the restored leaves live in the TARGET plan's layout
+        assert _leaf_axes(restored, "embed_head/embed") == check_axes["embed"]
+        assert _leaf_axes(restored, "blocks") == check_axes["blocks"]
+        ev = _events_since(n0, "fault/reshard")
+        assert len(ev) == 1
+        assert ev[0]["from_plan"] == plan4.signature()
+        assert ev[0]["to_plan"] == target.signature()
+        assert ev[0]["from_axes"]["model"] == 4
+
+    def test_same_composed_plan_restore_emits_no_reshard(self, tmp_path):
+        plan = compose(tp=2, pp=2)
+        state = _state(plan)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(state, step=1, plan=plan)
+            ck.wait()
+            n0 = _mark()
+            ck.restore(_state(plan, seed=3), plan=plan)
+        assert _events_since(n0, "fault/reshard") == []
+
+    def test_logical_mismatch_refuses_before_reading_data(self, tmp_path):
+        """A different MODEL is not a different mesh: the global-shape
+        check fires before any data read AND before the reshard event —
+        no half-restored state, no misleading telemetry."""
+        plan4 = compose(tp=4)
+        state = _state(plan4)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(state, step=1, plan=plan4)
+            ck.wait()
+            target = compose()
+            other = _state(target, vocab=48)  # different embed/head shapes
+            n0 = _mark()
+            with pytest.raises(
+                ValueError,
+                match="checkpoint cannot reshard onto the target topology",
+            ):
+                ck.restore(other, plan=target)
+        assert _events_since(n0, "fault/reshard") == []
+        assert _events_since(n0, "ckpt/restore") == []

@@ -130,9 +130,15 @@ class BatchBufferPool:
         self._recycled = reg.counter("data/ring_recycled")
 
     def acquire(self, batch: int, item_shape: tuple, dtype,
-                with_valid: bool) -> _BatchLease:
-        """A free pooled lease, or a freshly allocated one (counted)."""
-        spec = (int(batch), tuple(item_shape), np.dtype(dtype), bool(with_valid))
+                with_valid: bool, label_shape: tuple = (),
+                label_dtype=np.int32) -> _BatchLease:
+        """A free pooled lease, or a freshly allocated one (counted).
+
+        ``label_shape``/``label_dtype`` size the per-sample label row:
+        ``()`` int32 for classification, ``(L,)`` for next-token LM
+        targets — the ring serves both without a second pool."""
+        spec = (int(batch), tuple(item_shape), np.dtype(dtype),
+                bool(with_valid), tuple(label_shape), np.dtype(label_dtype))
         with self._lock:
             if spec != self._spec:  # shape/dtype change: old buffers useless
                 self._spec = spec
@@ -142,7 +148,7 @@ class BatchBufferPool:
         self._allocs.inc()
         return _BatchLease(
             _alloc_unaliasable((batch,) + tuple(item_shape), dtype),
-            _alloc_unaliasable((batch,), np.int32),
+            _alloc_unaliasable((batch,) + tuple(label_shape), label_dtype),
             _alloc_unaliasable((batch,), np.bool_) if with_valid else None,
         )
 
@@ -161,6 +167,8 @@ class BatchBufferPool:
                 lease.images.shape[1:],
                 lease.images.dtype,
                 lease.valid is not None,
+                lease.labels.shape[1:],
+                lease.labels.dtype,
             )
             if lease_spec == self._spec and len(self._free) < self.size:
                 self._free.append(lease)
@@ -651,10 +659,12 @@ class DataLoader:
             zero-allocation replacement for per-batch ``np.stack``."""
             n = len(items)
             first = np.asarray(items[0][0])
+            first_lb = np.asarray(items[0][1])
             dtype = self.transfer_dtype or first.dtype
             lease = self._pool.acquire(
                 self.local_batch_size, first.shape, dtype,
                 with_valid=not self.drop_last,
+                label_shape=first_lb.shape, label_dtype=first_lb.dtype,
             )
             for i, (im, lb) in enumerate(items):
                 # same_kind: a float sample under transfer_dtype="uint8"

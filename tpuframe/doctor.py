@@ -421,6 +421,35 @@ def comms_section() -> dict:
     return out
 
 
+def parallel_section() -> dict:
+    """State of the composed-parallelism knobs (``parallel.compose``):
+    the resolved pipeline/TP env (``TPUFRAME_PP_*``/``TPUFRAME_TP_SIZE``),
+    the legal schedules, and the paste-ready pipeline A/B one-liner.
+    Stdlib-only reads (``parallel.comms_env``) — works against a wedged
+    backend; what mesh the plan actually composed is a runtime question
+    the ``pp/schedule`` event answers."""
+    from tpuframe.parallel.comms_env import (
+        PP_SCHEDULE_CHOICES,
+        pp_microbatches,
+        pp_schedule,
+        tp_size,
+    )
+
+    return {
+        "pp_microbatches": pp_microbatches() or None,
+        "pp_schedule": pp_schedule(),
+        "tp_size": tp_size(),
+        "schedules": list(PP_SCHEDULE_CHOICES),
+        "env": {
+            k: os.environ[k]
+            for k in ("TPUFRAME_PP_MICROBATCHES", "TPUFRAME_PP_SCHEDULE",
+                      "TPUFRAME_TP_SIZE")
+            if k in os.environ
+        },
+        "bench": "python benchmarks/bench_collectives.py --pipeline",
+    }
+
+
 def profile_section() -> dict:
     """State of the device-time capture path (`track/profiler.py` +
     `track/device_time.py`): the ``TPUFRAME_PROFILE_*`` knobs (malformed
@@ -607,6 +636,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "fleet": fleet_section(),
         "slo": slo_section(),
         "comms": comms_section(),
+        "parallel": parallel_section(),
         "profile": profile_section(),
         "autotune": autotune_section(devices),
         "lint": lint_section(),

@@ -31,9 +31,13 @@ _LAZY = {
     "spec_from_json": "tpuframe.parallel.sharding",
     "spec_to_json": "tpuframe.parallel.sharding",
     "PipelinedTransformerLM": "tpuframe.parallel.pipeline",
+    "PP_SCHEDULES": "tpuframe.parallel.pipeline",
     "gpipe_spmd": "tpuframe.parallel.pipeline",
     "pipeline_param_spec": "tpuframe.parallel.pipeline",
     "stack_stage_params": "tpuframe.parallel.pipeline",
+    "compose": "tpuframe.parallel.compose",
+    "default_tp_rules": "tpuframe.parallel.compose",
+    "pipeline_rules": "tpuframe.parallel.compose",
     "quantized_pmean": "tpuframe.parallel.compression",
     "CommsConfig": "tpuframe.parallel.comms_env",
     "COMMS_ENV_VARS": "tpuframe.parallel.comms_env",
@@ -56,7 +60,11 @@ def __getattr__(name):
     if name in _LAZY:
         import importlib
 
-        return getattr(importlib.import_module(_LAZY[name]), name)
+        val = getattr(importlib.import_module(_LAZY[name]), name)
+        # cache the resolved attribute: for ``compose`` the function
+        # must win over the same-named submodule the import just bound
+        globals()[name] = val
+        return val
     raise AttributeError(f"module 'tpuframe.parallel' has no attribute {name!r}")
 
 

@@ -56,6 +56,21 @@ Knob semantics (the one table, mirrored in OBSERVABILITY.md):
   reads the flags at backend init.  No-op on CPU — the CPU compiler
   rejects the TPU/GPU scheduler flags, so the resolver returns an
   empty set there rather than aborting the process.
+- ``TPUFRAME_PP_MICROBATCHES`` — microbatches per pipeline step
+  (default 0 = unset: the model's ``n_microbatches`` default applies).
+  More microbatches shrink the GPipe bubble ``(S-1)/(M+S-1)``.  A
+  composed ``ParallelPlan.pp_microbatches`` pin (or an explicit model
+  field) wins over the env and rides the plan signature.
+- ``TPUFRAME_PP_SCHEDULE`` — pipeline hop/compute interleave policy:
+  ``interleaved`` (default; ``ppermute`` hops slot behind stage
+  compute), ``1f1b`` (interleaved + remat-bounded backward stash), or
+  ``barriered`` (hop-then-compute serialized — the A/B baseline arm of
+  ``bench_collectives.py --pipeline``, not a production schedule).  A
+  ``ParallelPlan.pp_schedule`` pin wins over the env.
+- ``TPUFRAME_TP_SIZE`` — tensor-parallel (``model`` axis) size
+  ``parallel.compose.compose`` builds its mesh with when the caller
+  doesn't pass ``tp=`` (default 1 = no TP).  Restart-only: the mesh is
+  laid out at ``initialize``.
 """
 
 # tpuframe-lint: stdlib-only
@@ -69,10 +84,14 @@ __all__ = [
     "COMMS_ENV_VARS",
     "CommsConfig",
     "COMPRESSION_MODES",
+    "PP_SCHEDULE_CHOICES",
     "comms_async_enabled",
     "comms_async_flags",
     "comms_async_platform",
     "comms_fused_block",
+    "pp_microbatches",
+    "pp_schedule",
+    "tp_size",
 ]
 
 #: the comms spine's env knobs — aggregated by
@@ -86,6 +105,9 @@ COMMS_ENV_VARS = (
     "TPUFRAME_COMMS_FUSED",
     "TPUFRAME_COMMS_FUSED_BLOCK",
     "TPUFRAME_COMMS_ASYNC",
+    "TPUFRAME_PP_MICROBATCHES",
+    "TPUFRAME_PP_SCHEDULE",
+    "TPUFRAME_TP_SIZE",
 )
 
 #: value domains for the knobs above (KN007).  All "restart":
@@ -104,10 +126,23 @@ COMMS_ENV_DOMAINS = {
     "TPUFRAME_COMMS_FUSED_BLOCK": {
         "type": "int", "range": (128, 65536), "apply": "restart"},
     "TPUFRAME_COMMS_ASYNC": {"type": "bool", "apply": "restart"},
+    "TPUFRAME_PP_MICROBATCHES": {
+        "type": "int", "range": (0, 4096), "apply": "restart"},
+    "TPUFRAME_PP_SCHEDULE": {
+        "type": "enum",
+        "choices": ("", "interleaved", "barriered", "1f1b"),
+        "apply": "restart"},
+    "TPUFRAME_TP_SIZE": {
+        "type": "int", "range": (1, 64), "apply": "restart"},
 }
 
 #: wire formats the compressed collectives understand
 COMPRESSION_MODES = ("int8", "fp8")
+
+#: pipeline schedules the env knob accepts — the one source of truth
+#: (``parallel.pipeline.PP_SCHEDULES`` re-exports it); lives here,
+#: stdlib-only, so the registry stays importable from a jax-less process
+PP_SCHEDULE_CHOICES = ("interleaved", "barriered", "1f1b")
 
 _FALSY = {"0", "false", "off", "no", ""}
 
@@ -281,3 +316,40 @@ def comms_fused_block(environ: dict | None = None) -> int:
         val = 2048
     val = max(128, min(65536, val))
     return (val // 128) * 128
+
+
+def pp_microbatches(environ: dict | None = None) -> int:
+    """``TPUFRAME_PP_MICROBATCHES`` resolved and clamped to its declared
+    domain; 0 = unset (the model's ``n_microbatches`` default applies).
+    A composed plan's ``pp_microbatches`` pin wins over this env value."""
+    env = os.environ if environ is None else environ
+    raw = str(env.get("TPUFRAME_PP_MICROBATCHES", "") or "").strip()
+    try:
+        val = int(raw) if raw else 0
+    except ValueError:
+        val = 0
+    return max(0, min(4096, val))
+
+
+def pp_schedule(environ: dict | None = None) -> str:
+    """``TPUFRAME_PP_SCHEDULE`` resolved against
+    :data:`PP_SCHEDULE_CHOICES`; unset/unknown values fall back to
+    ``interleaved`` (tolerant like the other comms knobs — the pipeline
+    primitive itself is the loud validator for programmatic schedules).
+    A ``ParallelPlan.pp_schedule`` pin wins over this env value."""
+    env = os.environ if environ is None else environ
+    raw = str(env.get("TPUFRAME_PP_SCHEDULE", "") or "").strip().lower()
+    return raw if raw in PP_SCHEDULE_CHOICES else "interleaved"
+
+
+def tp_size(environ: dict | None = None) -> int:
+    """``TPUFRAME_TP_SIZE`` resolved and clamped to its declared domain
+    (default 1 = no tensor parallelism); ``parallel.compose.compose``
+    reads it when the caller doesn't pass ``tp=`` explicitly."""
+    env = os.environ if environ is None else environ
+    raw = str(env.get("TPUFRAME_TP_SIZE", "") or "").strip()
+    try:
+        val = int(raw) if raw else 1
+    except ValueError:
+        val = 1
+    return max(1, min(64, val))

@@ -1,0 +1,195 @@
+"""Plan composition: one declaration, one ``ParallelPlan``, N dimensions.
+
+TorchTitan (arXiv:2410.06511) made composable N-D parallelism a
+production requirement: DP, ZeRO sharding, tensor parallelism, pipeline
+stages, and sequence sharding are *one* configuration, not five
+subsystems glued per-run.  tpuframe's pieces all exist — the mesh axes
+(``core.runtime.AXIS_ORDER``), the ZeRO ladder and TP rules
+(``parallel.sharding``), the SPMD GPipe schedule (``parallel.pipeline``),
+the compressed/fused wire (``parallel.compression``) — but each caller
+had to assemble them by hand.  :func:`compose` is the one assembly
+point:
+
+>>> plan = compose(tp=2, pp=2, zero_stage=3, microbatches=8)
+
+yields a :class:`~tpuframe.parallel.sharding.ParallelPlan` over a
+``pipe × data × fsdp × seq × model`` mesh whose
+
+- TP rules place the vocab-parallel embed/head matrices on ``model``
+  (the GSPMD region outside the pipeline's ``shard_map`` — XLA inserts
+  the TP collectives),
+- pipeline rule stores layer-stacked block params sharded over ``pipe``
+  (the exact layout ``gpipe_spmd``'s ``in_specs`` consume — no reshard
+  on entry),
+- ``pp_microbatches``/``pp_schedule`` pins ride the plan signature, so
+  the composed fit AOT-precompiles under the compile spine and a
+  schedule change is a *different plan*, never a silent recompile,
+- ZeRO stage and the comms-wire knobs pass through untouched — the
+  compressed gradient sync composes with stage-3 gather-on-use params
+  (``train.step`` owns that math).
+
+Everything downstream — topology manifests, checkpoints portable across
+*plan* changes, ``rebind()``/shrink-to-survivors, autotune store keys —
+keys on the composed plan's signature exactly as it does for hand-built
+plans, because the result IS a plain ``ParallelPlan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuframe.core.runtime import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    MeshSpec,
+)
+from tpuframe.parallel.comms_env import pp_microbatches, pp_schedule, tp_size
+from tpuframe.parallel.sharding import ParallelPlan, Rule, mesh_axes
+
+__all__ = ["compose", "default_tp_rules", "pipeline_rules"]
+
+
+def default_tp_rules(axis: str = MODEL_AXIS) -> tuple[Rule, ...]:
+    """Vocab-parallel tensor-parallel rules for the transformer LMs:
+    the embedding table splits its vocab rows and the (untied) LM head
+    splits its vocab columns over ``axis``.  These leaves live in the
+    GSPMD region (outside the pipeline's ``shard_map``), so XLA
+    propagates the sharding and inserts the TP collectives; block
+    params are deliberately NOT matched — inside the pipeline they are
+    stage-sharded over ``pipe`` and replicated over ``model``."""
+    return (
+        (r"embed_head/embed/embedding$", P(axis, None)),
+        (r"embed_head/lm_head/kernel$", P(None, axis)),
+    )
+
+
+def pipeline_rules(axis: str = PIPELINE_AXIS) -> tuple[Rule, ...]:
+    """Stage-sharding rule for layer-stacked pipeline block params: the
+    leading (layer) dim lives on ``pipe`` — the storage layout
+    ``gpipe_spmd`` consumes directly, so checkpoint manifests, ZeRO
+    state sharding, and the pipeline's ``in_specs`` all agree on where
+    every stage's weights live."""
+    return ((r"(^|/)blocks/", P(axis)),)
+
+
+def compose(
+    *,
+    mesh: Mesh | None = None,
+    dp: int = -1,
+    fsdp: int = 1,
+    tp: int | None = None,
+    pp: int = 1,
+    sp: int = 1,
+    zero_stage: int = 0,
+    microbatches: int | None = None,
+    schedule: str | None = None,
+    rules: Sequence[Rule] = (),
+    min_shard_elems: int = 2**14,
+    offload_optimizer: bool = False,
+    comms_groups: int | None = None,
+    comms_fused: bool | None = None,
+    devices: Any = None,
+) -> ParallelPlan:
+    """Declare "DP×ZeRO×TP×PP×SP over this topology"; get one plan.
+
+    Args:
+      mesh: an already-built mesh to compose over; when None, a
+        ``MeshSpec(pipe=pp, data=dp, fsdp=fsdp, seq=sp, model=tp)`` is
+        built over ``devices`` (default: all visible).  When a mesh IS
+        passed, the dimension arguments must agree with its axis sizes
+        (loud mismatch — a plan that silently ignores ``tp=4`` on a
+        ``model=1`` mesh is exactly the composition bug this exists to
+        prevent).
+      dp / fsdp / tp / pp / sp: axis sizes (``dp=-1`` absorbs the
+        remainder; ``tp=None`` resolves ``TPUFRAME_TP_SIZE``, default 1).
+      zero_stage: the DeepSpeed ladder (0..3) — stage 3 shards params
+        over ``fsdp`` with gather-on-use; composes with ``tp``/``pp``
+        rules and with the compressed wire.
+      microbatches: pipeline microbatch pin (None resolves
+        ``TPUFRAME_PP_MICROBATCHES``; 0/unset leaves the model default).
+      schedule: pipeline interleave pin (None resolves
+        ``TPUFRAME_PP_SCHEDULE``).  Env-resolved values are written INTO
+        the plan fields, so the signature names the program that
+        actually runs.
+      rules: extra TP rules, matched BEFORE the derived defaults (first
+        match wins — a caller rule overrides the vocab-parallel default).
+
+    Emits one ``parallel/compose`` event carrying the resolved
+    dimensions and the composed signature.
+    """
+    from tpuframe.track.telemetry import get_telemetry
+
+    if tp is None:
+        tp = tp_size()
+    if mesh is None:
+        mesh = MeshSpec(
+            pipe=pp, data=dp, fsdp=fsdp, seq=sp, model=tp
+        ).build(devices)
+    axes = mesh_axes(mesh)
+    declared = {
+        PIPELINE_AXIS: pp, FSDP_AXIS: fsdp, MODEL_AXIS: tp, SEQUENCE_AXIS: sp,
+    }
+    if dp != -1:
+        declared[DATA_AXIS] = dp
+    mismatch = {
+        name: (size, axes.get(name, 1))
+        for name, size in declared.items()
+        if size != -1 and axes.get(name, 1) != size
+    }
+    if mismatch:
+        raise ValueError(
+            "composed dimensions disagree with the mesh: "
+            + ", ".join(
+                f"{name}={want} declared but mesh has {have}"
+                for name, (want, have) in sorted(mismatch.items())
+            )
+            + " — pass a matching mesh or let compose() build one"
+        )
+    pp = int(axes.get(PIPELINE_AXIS, 1))
+    tp = int(axes.get(MODEL_AXIS, 1))
+
+    composed_rules: list[Rule] = list(rules)
+    if tp > 1:
+        composed_rules.extend(default_tp_rules())
+    if pp > 1:
+        composed_rules.extend(pipeline_rules())
+
+    if microbatches is None:
+        microbatches = pp_microbatches() or None
+    if schedule is None:
+        schedule = pp_schedule()
+    plan = ParallelPlan(
+        mesh=mesh,
+        zero_stage=zero_stage,
+        rules=tuple(composed_rules),
+        min_shard_elems=min_shard_elems,
+        offload_optimizer=offload_optimizer,
+        comms_groups=comms_groups,
+        comms_fused=comms_fused,
+        # schedule pins only exist where there IS a pipeline: a pp=1
+        # plan keeps the None defaults so its signature is byte-stable
+        # with every pre-existing plan over the same mesh
+        pp_microbatches=microbatches if pp > 1 else None,
+        pp_schedule=schedule if pp > 1 else None,
+    )
+    get_telemetry().event(
+        "parallel/compose",
+        mesh_axes=axes,
+        world=int(mesh.devices.size),
+        dp=int(axes.get(DATA_AXIS, 1)),
+        fsdp=int(axes.get(FSDP_AXIS, 1)),
+        tp=tp,
+        pp=pp,
+        sp=int(axes.get(SEQUENCE_AXIS, 1)),
+        zero_stage=int(zero_stage),
+        microbatches=plan.pp_microbatches,
+        schedule=plan.pp_schedule,
+        rules=len(composed_rules),
+        signature=plan.signature(),
+    )
+    return plan

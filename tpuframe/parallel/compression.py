@@ -233,7 +233,7 @@ def grad_layout(tree: Any, config: CommsConfig, plan: Any = None,
     else:
         axes, world = (), 1
     update_specs: dict[str, tuple] = {}
-    if plan is not None and getattr(plan, "zero_stage", 0) in (1, 2):
+    if plan is not None and getattr(plan, "zero_stage", 0) in (1, 2, 3):
         update_specs = plan.update_shard_specs(tree)
     flat, sliced, exact = [], [], []
     offset = 0

@@ -43,6 +43,53 @@ from jax.sharding import PartitionSpec as P
 
 from tpuframe.core.runtime import DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS
 from tpuframe.core.runtime import shard_map
+from tpuframe.parallel.comms_env import PP_SCHEDULE_CHOICES
+
+
+@jax.custom_vjp
+def _tick_barrier(xs):
+    """``optimization_barrier`` with a gradient: identity math, but XLA
+    may not move work across it.  ``lax.optimization_barrier`` has no
+    autodiff rule, and the barriered schedule must be trainable (it is
+    the serialized baseline arm of the schedule A/B) — the cotangents
+    get the same barrier, pinning the backward hops to their tick
+    boundaries too."""
+    return lax.optimization_barrier(xs)
+
+
+def _tick_barrier_fwd(xs):
+    return lax.optimization_barrier(xs), None
+
+
+def _tick_barrier_bwd(_, cts):
+    return (lax.optimization_barrier(cts),)
+
+
+_tick_barrier.defvjp(_tick_barrier_fwd, _tick_barrier_bwd)
+
+#: The pipeline hop/compute interleave policies :func:`gpipe_spmd`
+#: understands (resolved from ``ParallelPlan.pp_schedule`` /
+#: ``TPUFRAME_PP_SCHEDULE``):
+#:
+#: - ``interleaved`` (default) — each tick's ``ppermute`` hop is
+#:   dataflow-independent of the next tick's stage compute for every
+#:   stage but the hop's consumer, so the latency-hiding scheduler slots
+#:   the nearest-neighbour transfer behind compute (the PR-15 group-
+#:   scheduler discipline applied to the pipeline wire).
+#: - ``barriered`` — an ``optimization_barrier`` ties each hop to the
+#:   tick boundary: hop-then-compute, strictly serialized.  Exists as
+#:   the measured A/B baseline arm (``bench_collectives.py --pipeline``),
+#:   not a production schedule.
+#: - ``1f1b`` — interleaved hops plus per-tick stage rematerialization
+#:   forced ON: the backward stash is bounded to each tick's stage
+#:   *input* (the 1F1B-style O(S) stash bound this SPMD formulation can
+#:   honestly buy — see the schedule-choice note above) regardless of
+#:   the ``remat_stages`` flag.
+#:
+#: The tuple itself lives in the stdlib-only knob registry
+#: (``comms_env.PP_SCHEDULE_CHOICES``) so doctor/aggregator can read it
+#: from a jax-less process; this is the same object.
+PP_SCHEDULES = PP_SCHEDULE_CHOICES
 
 
 def gpipe_spmd(
@@ -54,6 +101,7 @@ def gpipe_spmd(
     axis: str = PIPELINE_AXIS,
     batch_axes: tuple = (DATA_AXIS, FSDP_AXIS),
     remat_stages: bool = False,
+    schedule: str = "interleaved",
 ) -> jax.Array:
     """Run ``stage_fn`` as an S-stage GPipe pipeline over ``mesh[axis]``.
 
@@ -69,11 +117,20 @@ def gpipe_spmd(
         intermediate inside the stage, cutting pipeline activation
         memory by roughly the stage depth at ~1/3 extra FLOPs (the
         standard trade for deep stages / long sequences).
+      schedule: hop/compute interleave policy — one of
+        :data:`PP_SCHEDULES`.  Every schedule computes the identical
+        values (``barriered`` only constrains ordering; ``1f1b`` only
+        changes what the backward stashes), so the A/B across schedules
+        is bit-exact on outputs.
 
     Returns ``(M, micro, ...)`` outputs, numerically identical to applying
     stages 0..S-1 sequentially to each microbatch.
     """
-    if remat_stages:
+    if schedule not in PP_SCHEDULES:
+        raise ValueError(
+            f"schedule must be one of {PP_SCHEDULES}, got {schedule!r}"
+        )
+    if remat_stages or schedule == "1f1b":
         stage_fn = jax.checkpoint(stage_fn)
     n_stages = mesh.shape[axis] if axis in mesh.shape else 1
     if n_stages == 1:
@@ -121,6 +178,11 @@ def gpipe_spmd(
             outputs = jnp.where((s == last) & (done >= 0), updated, outputs)
             # hop: stage i's output becomes stage i+1's next input
             state = lax.ppermute(y_out, axis, perm)
+            if schedule == "barriered":
+                # pin the hop to the tick boundary: nothing in the next
+                # tick may start until the transfer lands (the serialized
+                # baseline the interleaved schedule is measured against)
+                state, outputs = _tick_barrier((state, outputs))
             return (state, outputs), None
 
         (state, outputs), _ = lax.scan(
@@ -172,10 +234,17 @@ class PipelinedTransformerLM:
     head_dim: int = 32
     max_len: int = 2048
     mlp_ratio: int = 4
-    n_microbatches: int = 4
+    #: microbatches per step; None resolves ``TPUFRAME_PP_MICROBATCHES``
+    #: (falling back to 4) — an explicit value (or a composed plan's
+    #: ``pp_microbatches`` pin threaded here) wins over the env
+    n_microbatches: int | None = None
     dtype: Any = jnp.float32
     #: rematerialize each stage in the backward (see gpipe_spmd)
     remat: bool = False
+    #: hop/compute interleave policy (one of ``PP_SCHEDULES``); None
+    #: resolves ``TPUFRAME_PP_SCHEDULE`` (default ``interleaved``) — an
+    #: explicit value (or a plan pin threaded here) wins over the env
+    schedule: str | None = None
 
     def __post_init__(self):
         import flax.linen as nn
@@ -266,15 +335,22 @@ class PipelinedTransformerLM:
                 y = self._block.apply({"params": layer_p}, y, train=train)
             return y
 
+        from tpuframe.parallel.comms_env import pp_microbatches, pp_schedule
+
         b = x.shape[0]
-        m = min(self.n_microbatches, b)
+        n_micro = (
+            self.n_microbatches if self.n_microbatches is not None
+            else (pp_microbatches() or 4)
+        )
+        m = min(n_micro, b)
         if b % m:
             raise ValueError(
                 f"batch size {b} must be divisible by n_microbatches={m}"
             )
         micro = x.reshape((m, b // m) + x.shape[1:])
         out = gpipe_spmd(
-            stage_fn, blocks, micro, mesh=mesh, remat_stages=self.remat
+            stage_fn, blocks, micro, mesh=mesh, remat_stages=self.remat,
+            schedule=self.schedule or pp_schedule(),
         )
         x = out.reshape((b,) + out.shape[2:])
         return self._embed_head.apply(

@@ -158,6 +158,19 @@ class ParallelPlan:
     #: plan signature and the AOT compile labels — a fused and a staged
     #: program are different programs.
     comms_fused: bool | None = None
+    #: microbatch count for the pipeline schedule (``parallel.pipeline``):
+    #: None defers to the model/``TPUFRAME_PP_MICROBATCHES`` env knob; an
+    #: explicit value pins the schedule depth on the plan so it rides the
+    #: plan signature and the AOT compile labels — a different microbatch
+    #: count is a different scanned program.
+    pp_microbatches: int | None = None
+    #: pipeline hop/compute interleave policy (``parallel.pipeline``
+    #: schedules): None defers to ``TPUFRAME_PP_SCHEDULE`` (default
+    #: ``interleaved``); an explicit value pins it on the plan.
+    #: ``interleaved`` lets the scheduler slot ``ppermute`` hops between
+    #: stage compute, ``1f1b`` adds remat-bounded stage stashes, and
+    #: ``barriered`` serializes hop-then-compute (the A/B baseline arm).
+    pp_schedule: str | None = None
 
     def __post_init__(self):
         if self.zero_stage not in (0, 1, 2, 3):
@@ -169,6 +182,17 @@ class ParallelPlan:
         if self.comms_fused not in (None, True, False):
             raise ValueError(
                 f"comms_fused must be a bool or None, got {self.comms_fused!r}"
+            )
+        if self.pp_microbatches is not None and self.pp_microbatches < 1:
+            raise ValueError(
+                f"pp_microbatches must be >= 1 (or None), got {self.pp_microbatches}"
+            )
+        from tpuframe.parallel.pipeline import PP_SCHEDULES
+
+        if self.pp_schedule is not None and self.pp_schedule not in PP_SCHEDULES:
+            raise ValueError(
+                f"pp_schedule must be one of {PP_SCHEDULES} (or None), "
+                f"got {self.pp_schedule!r}"
             )
         if self.offload_optimizer and not host_memory_available(self.mesh):
             # loud, not silent: a user who asked for DeepSpeed-style CPU
@@ -217,6 +241,14 @@ class ParallelPlan:
         # staged program every pre-existing signature already names)
         if self.comms_fused:
             payload["comms_fused"] = True
+        # pipeline-schedule pins are program identity too (a different
+        # microbatch count or interleave policy lowers a different scanned
+        # program), but the defaults are omitted so pre-existing plan
+        # signatures stay byte-stable
+        if self.pp_microbatches is not None:
+            payload["pp_microbatches"] = int(self.pp_microbatches)
+        if self.pp_schedule is not None and self.pp_schedule != "interleaved":
+            payload["pp_schedule"] = str(self.pp_schedule)
         blob = json.dumps(payload, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:12]
 
@@ -241,16 +273,24 @@ class ParallelPlan:
             "pinned": self.comms_groups is not None,
             "fused": bool(fused),
             "fused_pinned": self.comms_fused is not None,
+            "pp_schedule": self.pp_schedule or "interleaved",
+            "pp_pinned": self.pp_schedule is not None,
         }
 
     def describe_topology(self) -> dict:
         """The plan's topology as manifest-shaped JSON (mesh axes, world
-        size, signature) — what ``fault/world_resized`` events carry."""
+        size, signature) — what ``fault/world_resized`` events carry.
+        The ``pipeline_stages``/``tp_size`` breakout names the composed
+        N-D split explicitly so a plan-change restore (TP=4 saved,
+        TP=2×PP=2 target) reads as a *plan* move, not just a mesh diff."""
+        axes = mesh_axes(self.mesh)
         return {
-            "mesh_axes": mesh_axes(self.mesh),
+            "mesh_axes": axes,
             "world_size": int(self.mesh.devices.size),
             "plan_signature": self.signature(),
             "zero_stage": self.zero_stage,
+            "pipeline_stages": int(axes.get("pipe", 1)),
+            "tp_size": int(axes.get("model", 1)),
         }
 
     def rebind(self, mesh: Mesh) -> "ParallelPlan":
@@ -361,7 +401,7 @@ class ParallelPlan:
 
     def update_shard_specs(self, params: Any) -> dict[str, tuple]:
         """The plan-derived weight-update sharding (arXiv:2004.13336,
-        mechanically from the data-parallel graph): for ZeRO stage 1/2,
+        mechanically from the data-parallel graph): for ZeRO stage 1/2/3,
         every param leaf big enough to shard (``min_shard_elems``) with
         a dimension divisible by the *combined* data-parallel world is
         assigned ``{path: (dim, axes)}`` — the compressed train step
@@ -374,7 +414,7 @@ class ParallelPlan:
         axes = tuple(a for a in self.data_axes if self.axis_size(a) > 1)
         world = int(np.prod([self.axis_size(a) for a in axes])) if axes else 1
         out: dict[str, tuple] = {}
-        if world <= 1 or self.zero_stage not in (1, 2):
+        if world <= 1 or self.zero_stage not in (1, 2, 3):
             return out
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
             shape = tuple(getattr(leaf, "shape", ()) or ())

@@ -228,6 +228,7 @@ def make_train_step(
     batch_transform: Callable[[dict], dict] | None = None,
     grad_compression: str | None = None,
     health=None,
+    grad_clip: float | None = None,
 ) -> Callable[[TrainState, Mapping[str, jax.Array]], tuple[TrainState, dict]]:
     """Build the jitted train step: (state, batch) -> (state, metrics).
 
@@ -243,9 +244,14 @@ def make_train_step(
     implicit GSPMD gradient all-reduce for an explicit bucketed,
     error-feedback quantized mean (EQuARX-style, see
     :mod:`tpuframe.parallel.compression`) — ~4x fewer sync bytes where
-    DCN bandwidth bounds DP scaling.  Composes with DP and ZeRO-1/2
+    DCN bandwidth bounds DP scaling.  Composes with DP and ZeRO-1/2/3
     plans (plan-derived compressed reduce-scatter -> sharded update ->
-    all-gather); ZeRO-3/TP re-shard the params themselves and refuse.
+    all-gather; stage 3 adds gather-on-use over the fsdp-resident
+    params); TP/pipeline rules re-shard params inside the model and
+    refuse — their shard_map cannot nest inside the compressed one.
+    ``grad_clip`` applies a plan-global-norm clip inside the compressed
+    step (the uncompressed path chains ``optax.clip_by_global_norm``
+    into ``tx`` instead and refuses the kwarg).
     BatchNorm: use the models' PLAIN/sync BN — inside ``shard_map`` it
     sees only its shard, i.e. shard-local statistics (torch-DDP
     semantics) fall out for free; ``bn_stats="local"``/``bn_groups`` is
@@ -264,7 +270,13 @@ def make_train_step(
         # mismatched shard_map and crash
         return _make_compressed_train_step(
             policy, loss_fn, donate, plan, batch_transform, grad_compression,
-            health,
+            health, grad_clip=grad_clip,
+        )
+    if grad_clip is not None:
+        raise ValueError(
+            "grad_clip is a compressed-step parameter (the clip needs the "
+            "plan-global synced norm); for the uncompressed step chain "
+            "optax.clip_by_global_norm into tx instead"
         )
     loss_fn = _bind_loss(loss_fn, plan)
 
@@ -334,6 +346,7 @@ def _make_compressed_train_step(
     grad_compression,
     health=None,
     n_microbatches: int = 1,
+    grad_clip: float | None = None,
 ):
     """shard_map train step with explicit bucketed, error-feedback
     compressed gradient sync (:mod:`tpuframe.parallel.compression`).
@@ -349,7 +362,19 @@ def _make_compressed_train_step(
       the optimizer updates only the owned slice against the plan's
       sharded state, and the f32 update is all-gathered back (the
       arXiv:2004.13336 pipeline, derived from
-      ``ParallelPlan.update_shard_specs``).
+      ``ParallelPlan.update_shard_specs``);
+    - stage 3: params additionally live fsdp-sharded BETWEEN steps
+      (``plan.param_spec``): the step all-gathers them on entry
+      (gather-on-use), runs the stage-1/2 sliced update against the
+      full view, and re-slices the new params back to their storage
+      shard on exit — the compressed wire is untouched, only the
+      params' resting layout changes.
+
+    ``grad_clip`` (a float) applies torch-style global-norm clipping to
+    the *synced* gradient before the update, using the plan-global norm
+    (sliced leaves psum across shards), so the scale is identical
+    everywhere; the health sentinel still judges the RAW norm — a
+    clipped-away spike is exactly what it must see.
 
     Metrics psum exactly (they're tiny).  Error feedback needs the
     ``TrainState.comms`` residual (``init_comms_state``); a state
@@ -387,12 +412,13 @@ def _make_compressed_train_step(
     # (plan-first, like comms_groups); the resolved flag rides the plan
     # signature, so fused and staged programs get distinct AOT keys
     config = resolve_fused(plan, config)
-    if plan.zero_stage == 3 or plan.rules:
+    if plan.rules:
         raise ValueError(
-            "grad_compression composes with DP and ZeRO-1/2 (replicated "
-            "params, plan-sharded update); ZeRO-3/TP re-shard the params "
-            "themselves and own their collectives (got "
-            f"zero_stage={plan.zero_stage}, rules={bool(plan.rules)})"
+            "grad_compression composes with DP and ZeRO-1/2/3 (the "
+            "compressed step owns the whole gradient wire); TP/pipeline "
+            "rules re-shard params inside the model and own their "
+            "collectives — a second shard_map cannot nest inside the "
+            f"compressed one (got rules={len(plan.rules)} on this plan)"
         )
     if plan.offload_optimizer:
         raise ValueError(
@@ -445,8 +471,53 @@ def _make_compressed_train_step(
         )
         sliced_dims = {path: dim for path, _, _, dim in layout.sliced}
         world = layout.world
+        # ZeRO-3 gather-on-use: params REST fsdp-sharded (plan.param_spec)
+        # and the step materializes the full view on entry / re-slices on
+        # exit.  fsdp_dims maps each sharded leaf to its storage dim.
+        fsdp_world = plan.axis_size(plan.fsdp_axis)
+        fsdp_dims: dict[str, int] = {}
+        if plan.zero_stage == 3 and fsdp_world > 1:
+            for p, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+                spec = plan.param_spec(path_str(p), tuple(leaf.shape))
+                for d, entry in enumerate(spec):
+                    names = entry if isinstance(entry, tuple) else (entry,)
+                    if plan.fsdp_axis in names:
+                        fsdp_dims[path_str(p)] = d
+
+        def gather_param(path, leaf):
+            dim = fsdp_dims.get(path_str(path))
+            if dim is None:
+                return leaf
+            return jax.lax.all_gather(leaf, plan.fsdp_axis, axis=dim, tiled=True)
+
+        def scatter_param(path, leaf):
+            dim = fsdp_dims.get(path_str(path))
+            if dim is None:
+                return leaf
+            chunk = leaf.shape[dim] // fsdp_world
+            i = jax.lax.axis_index(plan.fsdp_axis)
+            return jax.lax.dynamic_slice_in_dim(leaf, i * chunk, chunk, axis=dim)
 
         def shard_step(state: TrainState, batch: Mapping[str, jax.Array]):
+            if fsdp_dims:
+                # gather-on-use: full params for forward/backward/update;
+                # the steady-state HBM between steps holds only the shard
+                state = state.replace(
+                    params=jax.tree_util.tree_map_with_path(
+                        gather_param, state.params
+                    )
+                )
+
+            def _reslice(out):
+                new_state, out_metrics = out
+                if fsdp_dims:
+                    new_state = new_state.replace(
+                        params=jax.tree_util.tree_map_with_path(
+                            scatter_param, new_state.params
+                        )
+                    )
+                return new_state, out_metrics
+
             rng = state.step_rng("dropout")
             # decorrelate dropout across shards (params stay identical:
             # the synced gradient is what updates them)
@@ -558,19 +629,31 @@ def _make_compressed_train_step(
             gloss = jax.lax.pmean(loss, data_axes)
 
             if not sliced_dims:
-                # stage 0: identical full mean grads on every shard
+                # stage 0 (or a plan too small to slice): identical full
+                # mean grads on every shard
+                raw_sq = None
+                if grad_clip is not None:
+                    raw_sq = sum(
+                        jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                        for leaf in jax.tree.leaves(synced)
+                    )
+                    scale = jnp.minimum(
+                        1.0, grad_clip / jnp.maximum(jnp.sqrt(raw_sq), 1e-12)
+                    )
+                    synced = jax.tree.map(lambda g: g * scale, synced)
                 if health is None:
                     new_state = state.apply_gradients(
                         synced, batch_stats=new_stats
                     ).replace(comms=new_comms)
-                    return new_state, metrics
+                    return _reslice((new_state, metrics))
                 # the verdict must be identical on every shard (params
                 # are replicated and updated in lockstep): judge the
                 # GLOBAL mean loss — the grads are already synced
-                return _apply_with_health(
+                return _reslice(_apply_with_health(
                     state, synced, new_stats, gloss, metrics, health,
+                    grad_sq=raw_sq,
                     extra_state={"comms": (state.comms, new_comms)},
-                )
+                ))
 
             # -- stage 1/2: sharded optimizer update over owned slices --
             idx = jnp.int32(0)
@@ -629,13 +712,23 @@ def _make_compressed_train_step(
                 if path_str(p) not in sliced_dims
             )
             grad_sq = jax.lax.psum(sliced_sq, layout.axes) + full_sq
+            if grad_clip is not None:
+                # plan-global norm → identical scale on every shard
+                # (torch clip_grad_norm_ semantics, never shard-local);
+                # grad_sq stays RAW for the health verdict below
+                scale = jnp.minimum(
+                    1.0, grad_clip / jnp.maximum(jnp.sqrt(grad_sq), 1e-12)
+                )
+                synced = jax.tree.map(lambda g: g * scale, synced)
             if health is None:
-                return zero_apply(synced).replace(comms=new_comms), metrics
-            return _apply_with_health(
+                return _reslice(
+                    (zero_apply(synced).replace(comms=new_comms), metrics)
+                )
+            return _reslice(_apply_with_health(
                 state, synced, new_stats, gloss, metrics, health,
                 apply_fn=zero_apply, grad_sq=grad_sq,
                 extra_state={"comms": (state.comms, new_comms)},
-            )
+            ))
 
         # -- specs: state fields replicated except the plan-sharded
         # optimizer slices and the per-shard EF residuals --
@@ -663,6 +756,13 @@ def _make_compressed_train_step(
             rest = path_str(path[1:])
             if field == "comms":
                 return P(layout.axes)
+            if field == "params":
+                dim = fsdp_dims.get(rest)
+                if dim is not None:  # ZeRO-3 storage shard
+                    entries = [None] * len(leaf.shape)
+                    entries[dim] = plan.fsdp_axis
+                    return P(*entries)
+                return P()
             if field == "opt_state" and hasattr(leaf, "shape") and leaf.shape:
                 return opt_spec(rest, tuple(leaf.shape))
             return P()
@@ -759,6 +859,7 @@ def make_grad_accum_step(
     batch_transform: Callable[[dict], dict] | None = None,
     health=None,
     grad_compression=None,
+    grad_clip: float | None = None,
 ):
     """Gradient accumulation over leading-dim microbatches via ``lax.scan``.
 
@@ -777,7 +878,13 @@ def make_grad_accum_step(
         # unbound (mesh=None), same as make_train_step's compressed path
         return _make_compressed_train_step(
             policy, loss_fn, donate, plan, batch_transform,
-            grad_compression, health, n_microbatches,
+            grad_compression, health, n_microbatches, grad_clip=grad_clip,
+        )
+    if grad_clip is not None:
+        raise ValueError(
+            "grad_clip is a compressed-step parameter (the clip needs the "
+            "plan-global synced norm); for the uncompressed step chain "
+            "optax.clip_by_global_norm into tx instead"
         )
     loss_fn = _bind_loss(loss_fn, plan)
 

@@ -163,8 +163,11 @@ class Trainer:
         bytes where DCN bandwidth bounds scaling; see
         ``tpuframe.parallel.compression`` and PERF.md round 10).
         Composes with ``grad_accum`` (compress once per super-batch)
-        and ZeRO-1/2 plans (plan-derived compressed reduce-scatter →
-        sharded update → all-gather); refuses ZeRO-3/TP.  Default None
+        and ZeRO-1/2/3 plans (plan-derived compressed reduce-scatter →
+        sharded update → all-gather; stage 3 adds gather-on-use over
+        the fsdp-resident params) and with ``grad_clip`` (the clip
+        moves inside the compressed step as a plan-global-norm scale);
+        refuses TP/pipeline rules.  Default None
         follows ``TPUFRAME_COMMS_COMPRESSION`` (off unless set); the
         per-step wire bytes are metered as ``comms/bytes_on_wire``.
       health: training-health sentinel (``tpuframe.fault.health``).
@@ -291,6 +294,7 @@ class Trainer:
         self.health = _health.resolve_policy(health)
         self._health_flags: list = []
         self._comms_gauge_set = False
+        self._pp_gauge_set = False
 
         if plan is None:
             plan = ParallelPlan(mesh=rt.current_runtime().mesh)
@@ -304,12 +308,29 @@ class Trainer:
         ):
             self.model = self.model.clone(bn_groups=plan.dp_size)
 
+        # wire compression (tpuframe.parallel.compression): the explicit
+        # param wins; with grad_compression=None the fleet knob
+        # TPUFRAME_COMMS_COMPRESSION decides (off unless set).  Resolved
+        # BEFORE the optimizer chain — where the clip lives depends on it.
+        from tpuframe.parallel.compression import CommsConfig
+
+        self.comms_config = CommsConfig.from_env(grad_compression)
+        # DeepSpeed's gradient_clipping knob (`deepspeed_config.py:18`):
+        # global-norm clip.  With a ZeRO-sharded compressed wire the
+        # optimizer sees only each shard's update slice, so an optax
+        # chain clip would use a shard-local (silently wrong) norm — the
+        # clip moves INSIDE the compressed step instead, scaled by the
+        # plan-global synced norm (see _make_compressed_train_step).
+        self._step_grad_clip: float | None = None
         if tx is None:
             tx = _make_optimizer(optimizer, self._resolve_lr(lr))
             if grad_clip:
-                # DeepSpeed's gradient_clipping knob (`deepspeed_config.py:18`):
-                # global-norm clip chained before the update
-                tx = optax.chain(optax.clip_by_global_norm(float(grad_clip)), tx)
+                if self.comms_config is not None and plan.zero_stage >= 1:
+                    self._step_grad_clip = float(grad_clip)
+                else:
+                    tx = optax.chain(
+                        optax.clip_by_global_norm(float(grad_clip)), tx
+                    )
         elif grad_clip:
             raise ValueError(
                 "grad_clip only applies when the Trainer builds the optimizer "
@@ -414,23 +435,6 @@ class Trainer:
                 batch["image"] = image_transform(batch["image"], self.plan.mesh)
                 return batch
 
-        # wire compression (tpuframe.parallel.compression): the explicit
-        # param wins; with grad_compression=None the fleet knob
-        # TPUFRAME_COMMS_COMPRESSION decides (off when unset)
-        from tpuframe.parallel.compression import CommsConfig
-
-        self.comms_config = CommsConfig.from_env(grad_compression)
-        if (
-            self.comms_config is not None
-            and grad_clip
-            and self.plan.zero_stage in (1, 2)
-        ):
-            raise ValueError(
-                "grad_clip + grad_compression + ZeRO do not compose: the "
-                "clip's global norm would be computed over each shard's "
-                "update slice (shard-local, silently wrong); chain a "
-                "pre-aggregation clip into a custom tx or drop one knob"
-            )
         if grad_accum > 1:
             # DeepSpeed's gradient_accumulation_steps
             # (`deepspeed_config.py:17`): host batches are reshaped to
@@ -442,6 +446,7 @@ class Trainer:
                 batch_transform=train_transform,
                 health=self.health,
                 grad_compression=self.comms_config,
+                grad_clip=self._step_grad_clip,
             )
         else:
             self._train_step = make_train_step(
@@ -449,6 +454,7 @@ class Trainer:
                 batch_transform=train_transform,
                 grad_compression=self.comms_config,
                 health=self.health,
+                grad_clip=self._step_grad_clip,
             )
         self._eval_step = make_eval_step(
             self.policy, loss_fn, plan=self.plan, batch_transform=eval_transform
@@ -558,6 +564,31 @@ class Trainer:
             # transport — bytes are invariant under fusion, so this
             # counter is how dashboards tell the transports apart
             tele.registry.counter("comms/fused_steps").inc()
+
+    def _meter_pp(self, tele) -> None:
+        """Pipeline-plan accounting, same shape as the comms meter: the
+        schedule is static per plan signature, so the first step emits
+        one ``pp/schedule`` event + sets the gauges, and every pipelined
+        step is one host counter add.  Non-pipeline plans meter nothing."""
+        stages = self.plan.axis_size("pipe")
+        if stages <= 1:
+            return
+        sched = self.plan.comms_schedule()
+        if not self._pp_gauge_set:
+            tele.event(
+                "pp/schedule",
+                schedule=sched["pp_schedule"],
+                pinned=sched["pp_pinned"],
+                stages=stages,
+                microbatches=self.plan.pp_microbatches,
+                signature=self.plan.signature(),
+            )
+            tele.registry.gauge("pp/stages").set(stages)
+            tele.registry.gauge("pp/microbatches").set(
+                self.plan.pp_microbatches or 0
+            )
+            self._pp_gauge_set = True
+        tele.registry.counter("pp/steps").inc()
 
     # -- preemption ----------------------------------------------------------
     def _preempt_watcher(self):
@@ -1097,7 +1128,7 @@ class Trainer:
                     main_step is None or intra_step > main_step
                 ):
                     source = intra
-            state, restored_meta = source.maybe_restore(state)
+            state, restored_meta = source.maybe_restore(state, plan=self.plan)
             self.state = state
             if restored_meta:
                 self.epoch = int(restored_meta.get("epoch", 0))
@@ -1348,6 +1379,7 @@ class Trainer:
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
             self._meter_comms(tele)
+            self._meter_pp(tele)
             # boundary-to-boundary step time: charges whatever actually
             # slowed this rank (wait, dispatch, snapshot, callback)
             self._straggler.observe()
