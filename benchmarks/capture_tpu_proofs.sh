@@ -263,6 +263,14 @@ run bench_fused.json          600  python benchmarks/bench_collectives.py \
 run bench_pipeline.json       600  python benchmarks/bench_collectives.py \
   --pipeline
 
+# memory-plane rung: estimator vs compiled memory_analysis() vs the
+# LIVE device watermark for the dp/zero1/zero3 plan ladder — on the TPU
+# host hbm_peak_mb stops being null (memory_stats() exists) and the
+# committed `memory` block is what `track analyze --baseline` gates
+# ratio_peak_hbm against (exit 3): a plan whose footprint balloons
+# fails CI even at flat step time
+run bench_memory.json          300  python benchmarks/bench_memory.py
+
 # compile-spine rung: cold vs warm-cache vs AOT-overlapped
 # time-to-first-step on the real chip — the committed
 # time_to_first_step block is what `track analyze --baseline` gates

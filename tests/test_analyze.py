@@ -223,7 +223,63 @@ class TestSkewReportContract:
         from tpuframe.autotune.diagnosis import diagnose
 
         diag = diagnose(report)
-        assert diag.bound in set(A.SKEW_REPORT_BOUNDS) | {"comms", "none"}
+        assert diag.bound in set(A.SKEW_REPORT_BOUNDS) | {
+            "comms", "memory", "none"
+        }
+
+
+# -- memory block -------------------------------------------------------------
+
+
+class TestMemoryBlock:
+    """skew_report's `memory` block: built from the three memory-plane
+    event kinds, None when the plane left no trail (schema in
+    OBSERVABILITY.md "Reading a memory report")."""
+
+    def _ranks(self):
+        events = [
+            {"name": "memory/executable", "label": "train/step",
+             "peak_mb": 120.5},
+            {"name": "memory/executable", "label": "eval/step",
+             "peak_mb": 40.0},
+            {"name": "memory/watermark", "hbm_peak_mb": 900.0,
+             "host_peak_mb": 300.0, "hbm_limit_mb": 1000.0},
+            {"name": "memory/watermark", "hbm_peak_mb": 950.0,
+             "host_peak_mb": 280.0, "hbm_limit_mb": 1000.0},
+            {"name": "memory/oom", "where": "step", "step": 7,
+             "estimate_total_mb": 940.0, "budget_mb": 1000.0,
+             "fit": {"suggestion": {"zero_stage": 3, "fits": True,
+                                    "total_mb": 400.0}}},
+        ]
+        return [A.RankLog(0, events)]
+
+    def test_block_pins_its_contract_keys(self):
+        report = A.skew_report(self._ranks())
+        mem = report["memory"]
+        assert set(mem) == set(A.SKEW_REPORT_MEMORY_KEYS)
+
+    def test_block_aggregates_the_three_event_kinds(self):
+        mem = A.skew_report(self._ranks())["memory"]
+        assert mem["hbm_peak_mb"] == 950.0  # max over watermarks
+        assert mem["host_peak_mb"] == 300.0
+        assert mem["hbm_peak_util"] == pytest.approx(0.95)
+        assert mem["peak_executable_mb"] == 120.5
+        assert mem["executables"] == {"train/step": 120.5, "eval/step": 40.0}
+        assert mem["ooms"] == 1 and mem["budget_mb"] == 1000.0
+        last = mem["last_oom"]
+        assert last["where"] == "step" and last["step"] == 7
+        assert last["suggestion"]["zero_stage"] == 3
+
+    def test_plane_off_means_none_not_zeroes(self):
+        # the golden fixture predates the memory plane: incomparable
+        assert A.skew_report(A.load_dir(FIXTURE))["memory"] is None
+
+    def test_format_report_renders_memory_and_oom_lines(self):
+        text = A.format_report(A.skew_report(self._ranks()))
+        assert "hbm peak 950.0MB (95% of 1000MB)" in text
+        assert "compiled peak 120.5MB over 2 executable(s)" in text
+        assert "OOM: 1 event(s), last at step step 7" in text
+        assert "zero_stage=3" in text and "est 400.0MB" in text
 
 
 # -- Perfetto trace -----------------------------------------------------------
@@ -472,6 +528,31 @@ class TestBaselineDiff:
         # without the filter the TPU record trips a spurious regression
         diff = A.baseline_diff(self._report(), str(tmp_path))
         assert any(b["file"] == "tpu.json" for b in diff["regressions"])
+
+    def test_peak_hbm_regression_gates_like_step_time(self, tmp_path):
+        """A plan whose HBM footprint ballooned past threshold regresses
+        even at flat step time; a memory-less current run is
+        incomparable, not regressed."""
+        report = self._report()
+        (tmp_path / "mem.json").write_text(json.dumps({
+            "step_time": {"p50": 0.5, "p95": 0.6},  # step time NOT worse
+            "memory": {"peak_executable_mb": 100.0},
+        }))
+        grown = dict(report, memory={"hbm_peak_mb": 160.0})
+        diff = A.baseline_diff(grown, str(tmp_path))
+        entry = diff["baselines"][0]
+        assert entry["ratio_peak_hbm"] == pytest.approx(1.6)
+        assert entry["baseline_peak_hbm_mb"] == 100.0
+        assert diff["regressions"]
+        # flat footprint: compiled peak diffs against compiled peak
+        flat = dict(report, memory={"peak_executable_mb": 101.0})
+        diff = A.baseline_diff(flat, str(tmp_path))
+        assert not diff["regressions"]
+        assert diff["baselines"][0]["ratio_peak_hbm"] == pytest.approx(1.01)
+        # plane off this run: incomparable (memory is None in the report)
+        diff = A.baseline_diff(report, str(tmp_path))
+        assert not diff["regressions"]
+        assert "ratio_peak_hbm" not in diff["baselines"][0]
 
 
 # -- CLI ----------------------------------------------------------------------

@@ -254,13 +254,50 @@ class TestDiagnosis:
                  if m.knob == "TPUFRAME_LOADER_RING_BUFFERS"]
         assert rings == ["8", "16"]
 
+    def test_memory_bound_trumps_every_speed_signal(self):
+        # an OOM alongside a huge input-lost share: a plan that doesn't
+        # fit can't be tuned faster — memory wins
+        rep = _report(lost={"input": 50.0, "compute": 0.0, "checkpoint": 0.0})
+        rep["memory"] = {
+            "ooms": 1, "hbm_peak_util": 0.5,
+            "last_oom": {"where": "step", "step": 7,
+                         "suggestion": {"zero_stage": 3, "microbatches": 4,
+                                        "fits": True}},
+        }
+        diag = diagnose(rep)
+        assert diag.bound == "memory"
+        moves = {m.knob: m.value for m in diag.moves}
+        # the oom event's suggest_fit rung seeds the values
+        assert moves["TPUFRAME_ZERO_STAGE"] == "3"
+        assert moves["TPUFRAME_GRAD_ACCUM"] == "4"
+        assert "TPUFRAME_OFFLOAD_OPTIMIZER" not in moves  # rung didn't ask
+
+    def test_watermark_pressure_is_memory_bound_without_an_oom(self):
+        rep = _report()
+        rep["memory"] = {"ooms": 0, "hbm_peak_util": 0.95, "last_oom": None}
+        diag = diagnose(rep)
+        assert diag.bound == "memory"
+        moves = {m.knob: m.value for m in diag.moves}
+        # no suggestion to seed from: the escalation-ladder defaults
+        assert moves["TPUFRAME_ZERO_STAGE"] == "3"
+        assert moves["TPUFRAME_OFFLOAD_OPTIMIZER"] == "1"
+
+    def test_healthy_watermark_is_not_memory_bound(self):
+        rep = _report(lost={"input": 5.0, "compute": 0.0, "checkpoint": 0.0})
+        rep["memory"] = {"ooms": 0, "hbm_peak_util": 0.6, "last_oom": None}
+        assert diagnose(rep).bound == "input"
+
     def test_every_move_is_domain_legal(self):
         domains = all_env_domains()
+        mem_rep = _report()
+        mem_rep["memory"] = {"ooms": 1, "hbm_peak_util": 0.99,
+                             "last_oom": None}
         for rep in (
             _report(lost={"input": 5.0, "compute": 0.0, "checkpoint": 0.0}),
             _report(lost={"input": 0.0, "compute": 0.0, "checkpoint": 5.0}),
             _report(comms={"mode": None,
                            "allreduce_s": {"count": 100, "p50": 0.02}}),
+            mem_rep,
         ):
             for mv in diagnose(rep).moves:
                 assert clamp(mv.knob, mv.value, domains) == mv.value

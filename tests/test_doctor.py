@@ -28,6 +28,48 @@ def test_report_shape_on_cpu(monkeypatch):
     assert rec["optional_deps"]["msgpack"]  # hard dep, must resolve
 
 
+def test_memory_section_verdict_and_one_liner(monkeypatch, tmp_path):
+    """The doctor's memory section: knob state, persisted compiled
+    records, a fits/doesn't-fit verdict, and the paste-ready estimator
+    one-liner (which must actually run)."""
+    from tpuframe.track import memory as tmem
+
+    monkeypatch.setenv("TPUFRAME_COMPILE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TPUFRAME_MEMORY_BUDGET_MB", "1000")
+    # earlier test modules leave in-memory records behind; a fresh dict
+    # (auto-restored) keeps the executable count deterministic
+    monkeypatch.setattr(tmem, "_EXECUTABLES", {})
+
+    class _Stats:
+        argument_size_in_bytes = 500 * 1024 * 1024
+        temp_size_in_bytes = 100 * 1024 * 1024
+        output_size_in_bytes = 0
+        alias_size_in_bytes = 0
+
+    class _Compiled:
+        def memory_analysis(self):
+            return _Stats()
+
+    tmem.record_executable_memory(_Compiled(), "train/step")
+    sec = doctor.memory_section()
+    assert sec["knobs"]["TPUFRAME_MEMORY_BUDGET_MB"] == 1000.0
+    assert sec["executables"] == 1
+    assert sec["peak_known_mb"] == 600.0
+    assert sec["budget_mb"] == 1000.0
+    assert sec["verdict"].startswith("fits")
+    # the one-liner is advertised as paste-ready: hold it to that
+    cmd = sec["estimate"].split(" ", 2)
+    assert cmd[0] == "python" and cmd[1] == "-c"
+    proc = subprocess.run(
+        [sys.executable, "-c", cmd[2].strip('"')],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "params" in proc.stdout
+
+
 def test_probe_never_hangs_on_wedged_backend(monkeypatch):
     """The documented axon failure mode: jax.devices() hangs forever.
     The probe must time out and return a diagnosis, not hang."""

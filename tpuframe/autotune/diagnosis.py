@@ -13,6 +13,12 @@ human reads off ``python -m tpuframe.track.analyze``:
 - **comms-bound** (the ``comms`` block shows allreduce wall a large
   fraction of step wall at mode "none"): int8 wire compression, then
   bucket sizing.
+- **memory-bound** (the ``memory`` block carries an OOM, or the live
+  HBM watermark sits above ~92% of the device limit): raise the ZeRO
+  stage, split grad-accum microbatches, offload the optimizer — the
+  ``memory/oom`` event's ``suggest_fit`` rung seeds the values when one
+  exists.  Checked FIRST: a plan that doesn't fit can't be tuned
+  faster.
 - **compile** (cold-compile wall dominates total): make sure the AOT
   precompiler and the persistent compile cache are on.
 
@@ -37,6 +43,10 @@ __all__ = ["Diagnosis", "KnobMove", "diagnose"]
 #: below this fraction of total step wall, a bottleneck class is noise
 _SIGNIFICANT = 0.10
 
+#: HBM watermark / device limit above which the fit is one fragmentation
+#: spike away from RESOURCE_EXHAUSTED — memory-bound even without an OOM
+_MEM_PRESSURE = 0.92
+
 #: base-op name tokens that identify the compressed wire's staged
 #: encode/decode math in a ``device_time.top_ops`` row — the
 #: scale/round/clip/dequant chain XLA emits around a staged collective
@@ -58,7 +68,7 @@ class KnobMove:
 class Diagnosis:
     """What the report says is slow, and the ordered probe candidates."""
 
-    bound: str  # "input" | "checkpoint" | "comms" | "compute" | "none"
+    bound: str  # "input" | "checkpoint" | "comms" | "memory" | "compute" | "none"
     detail: dict
     moves: list[KnobMove]
 
@@ -97,6 +107,19 @@ def _classify(report: dict) -> tuple[str, dict]:
         "bound_votes": votes,
         "data_wait_fraction": round(wait_frac, 4),
     }
+
+    # memory first: an OOM (or a watermark one fragmentation spike from
+    # the limit) trumps every speed signal — a plan that doesn't fit
+    # can't be tuned faster
+    mem = report.get("memory") or None
+    if mem:
+        util = mem.get("hbm_peak_util") or 0.0
+        detail["memory"] = {
+            "ooms": mem.get("ooms") or 0,
+            "hbm_peak_util": round(util, 4),
+        }
+        if (mem.get("ooms") or 0) > 0 or util >= _MEM_PRESSURE:
+            return "memory", detail
 
     # multi-rank: straggler-attributed lost seconds name the bound
     if total_step_s > 0 and lost:
@@ -207,6 +230,29 @@ def diagnose(report: dict, *, gauges: dict | None = None) -> Diagnosis:
         move("TPUFRAME_GRAD_ACCUM", 2,
              "comms-bound: accumulate micro-batches, sync once per "
              "super-batch")
+    elif bound == "memory":
+        mem = report.get("memory") or {}
+        oom = mem.get("last_oom") or {}
+        sug = oom.get("suggestion") or {}
+        util = (detail.get("memory") or {}).get("hbm_peak_util") or 0.0
+        why = (
+            f"memory-bound: {mem.get('ooms') or 0} OOM event(s)"
+            if (mem.get("ooms") or 0) > 0
+            else f"memory-bound: HBM watermark at {util:.0%} of the limit"
+        )
+        # the estimator's nearest-fitting rung seeds the values when the
+        # OOM event carried one; the escalation-ladder defaults
+        # otherwise.  Every move still passes clamp + the
+        # never-commit-slower probe — a bad suggestion costs probe time,
+        # never a slower (or still-OOMing) run.
+        move("TPUFRAME_ZERO_STAGE", sug.get("zero_stage", 3),
+             why + " — shard optimizer/params over the data-parallel "
+             "world (restart)")
+        move("TPUFRAME_GRAD_ACCUM", sug.get("microbatches", 2),
+             why + " — smaller microbatch slices shrink live activations")
+        if sug.get("offload_optimizer") or not sug:
+            move("TPUFRAME_OFFLOAD_OPTIMIZER", True,
+                 why + " — optimizer state to pinned host memory")
     elif bound == "compute":
         # compute-bound is the healthy baseline; moves exist only when a
         # parsed capture NAMES where the compute goes — the top-op table

@@ -205,6 +205,12 @@ def precompile_call(fn, abstract_args: tuple, *, label: str):
     with tele.span("compile/backend_compile", label=label, **extra), \
             compile_label(label, span=True):
         compiled = lowered.compile()
+    # compiled truth for the memory plane: one memory/executable event
+    # per AOT compile, persisted next to the compile cache so restarts
+    # know their footprint without recompiling (never raises)
+    from tpuframe.track.memory import record_executable_memory
+
+    record_executable_memory(compiled, label)
     return compiled if target is fn else None
 
 

@@ -507,6 +507,69 @@ def profile_section() -> dict:
     return out
 
 
+def memory_section() -> dict:
+    """State of the memory plane (`track/memory.py` +
+    `parallel/memory.py`): the ``TPUFRAME_MEMORY_*`` knobs (malformed
+    values reported, not crashed on), the persisted executable-memory
+    records next to the compile cache (stdlib json — works against a
+    wedged backend), the process-wide watermarks, and a fits /
+    doesn't-fit verdict of the known peak against the resolved budget —
+    plus the paste-ready estimator one-liner, so a "will it fit" report
+    starts from numbers, not a recompile."""
+    from tpuframe.track.memory import (
+        MEMORY_ENV_VARS,
+        executable_records,
+        memory_env,
+        peaks,
+    )
+
+    env = memory_env()
+    errors = env.pop("errors")
+    out: dict = {
+        "knobs": env,
+        "env": {
+            k: os.environ[k] for k in MEMORY_ENV_VARS if k in os.environ
+        },
+        # the paste-ready capacity check: price the composed plan's
+        # budget before anything compiles
+        "estimate": (
+            "python -c \"from tpuframe.parallel import compose, plan_memory; "
+            "print(plan_memory(compose(), "
+            "{'w': ((4096, 4096), 'float32')})['per_device_mb'])\""
+        ),
+    }
+    if errors:
+        out["errors"] = errors
+    recs = executable_records()
+    live = peaks()
+    out["executables"] = len(recs)
+    out["watermarks"] = {k: round(v, 3) for k, v in live.items() if v}
+    # best known per-device peak: live watermark when the backend
+    # reports device stats, else the biggest compiled executable
+    peak = max(
+        (float(r.get("peak_mb") or 0.0) for r in recs.values()), default=0.0
+    )
+    peak = max(peak, float(live.get("hbm_peak_mb") or 0.0))
+    budget = (
+        float(env["TPUFRAME_MEMORY_BUDGET_MB"])
+        or float(live.get("hbm_limit_mb") or 0.0)
+    )
+    out["peak_known_mb"] = round(peak, 3) or None
+    out["budget_mb"] = round(budget, 3) or None
+    if peak and budget:
+        # 10% headroom for allocator fragmentation, same margin as
+        # suggest_fit
+        out["verdict"] = (
+            "fits" if peak <= 0.9 * budget
+            else "tight" if peak <= budget
+            else "does-not-fit"
+        )
+    else:
+        out["verdict"] = "unknown (no budget or no recorded peak — run " \
+                         "the estimator one-liner)"
+    return out
+
+
 def autotune_section(devices: dict | None = None) -> dict:
     """State of the self-tuning loop (``tpuframe.autotune``): whether it
     is armed, where the per-``(host, topology, signature)`` configs
@@ -638,6 +701,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "comms": comms_section(),
         "parallel": parallel_section(),
         "profile": profile_section(),
+        "memory": memory_section(),
         "autotune": autotune_section(devices),
         "lint": lint_section(),
         "env": {

@@ -32,6 +32,8 @@ from tpuframe.fault.chaos import (
     KillWorker,
     LoseRank,
     NaNAt,
+    OomAt,
+    OomError,
     PoisonRequest,
     PreemptNotice,
     QueueFlood,
@@ -81,6 +83,8 @@ __all__ = [
     "KillWorker",
     "LoseRank",
     "NaNAt",
+    "OomAt",
+    "OomError",
     "PREEMPTED_EXIT",
     "PoisonRequest",
     "Preempted",

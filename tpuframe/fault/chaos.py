@@ -45,6 +45,8 @@ __all__ = [
     "KillWorker",
     "LoseRank",
     "NaNAt",
+    "OomAt",
+    "OomError",
     "PoisonRequest",
     "PreemptNotice",
     "QueueFlood",
@@ -178,6 +180,33 @@ class RaiseAt(Injector):
         elif isinstance(exc, type):
             exc = exc(f"chaos: injected {exc.__name__} at {self.site}")
         raise exc
+
+
+class OomError(ChaosError):
+    """Synthetic allocation failure.  The message carries XLA's
+    ``RESOURCE_EXHAUSTED`` status name, so ``track.memory.is_oom``
+    classifies it exactly like a real HBM exhaustion — and it stays a
+    :class:`ChaosError` (retryable infra), because a real OOM after a
+    plan change is something supervised restarts may legitimately
+    retry into."""
+
+
+class OomAt(Injector):
+    """Raise a synthetic ``RESOURCE_EXHAUSTED`` at the site (default
+    ``step``) — the CPU-testable OOM.  The contract under test: the
+    forensics seam turns it into exactly one ``memory/oom`` event
+    carrying the estimator/compiled/live attribution table and a
+    ``suggest_fit`` plan suggestion, then re-raises untouched."""
+
+    def __init__(self, site: str = "step", step: int | None = None, *,
+                 times: int = 1):
+        super().__init__(site, step, times=times)
+
+    def fire(self, ctx: Mapping[str, Any]) -> None:
+        raise OomError(
+            "chaos: RESOURCE_EXHAUSTED: injected out-of-memory at "
+            f"{self.site} step {ctx.get('step')} (synthetic, OomAt)"
+        )
 
 
 class StallAt(Injector):

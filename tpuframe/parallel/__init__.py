@@ -38,6 +38,8 @@ _LAZY = {
     "compose": "tpuframe.parallel.compose",
     "default_tp_rules": "tpuframe.parallel.compose",
     "pipeline_rules": "tpuframe.parallel.compose",
+    "plan_memory": "tpuframe.parallel.memory",
+    "suggest_fit": "tpuframe.parallel.memory",
     "quantized_pmean": "tpuframe.parallel.compression",
     "CommsConfig": "tpuframe.parallel.comms_env",
     "COMMS_ENV_VARS": "tpuframe.parallel.comms_env",

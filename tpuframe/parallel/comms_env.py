@@ -71,6 +71,14 @@ Knob semantics (the one table, mirrored in OBSERVABILITY.md):
   ``parallel.compose.compose`` builds its mesh with when the caller
   doesn't pass ``tp=`` (default 1 = no TP).  Restart-only: the mesh is
   laid out at ``initialize``.
+- ``TPUFRAME_ZERO_STAGE`` — ZeRO stage [0, 3] ``compose`` uses when the
+  caller doesn't pass ``zero_stage=`` (default 0 = pure DP).  The
+  memory-bound autotune branch proposes stage moves through this knob;
+  restart-only because the state shardings are laid out at plan build.
+- ``TPUFRAME_OFFLOAD_OPTIMIZER`` — ``1`` defaults ``compose`` to
+  host-offloaded optimizer state (the plan still downgrades loudly on
+  backends without an addressable host space).  The estimator prices
+  the offloaded bytes as ``host_total`` instead of HBM.
 """
 
 # tpuframe-lint: stdlib-only
@@ -89,9 +97,11 @@ __all__ = [
     "comms_async_flags",
     "comms_async_platform",
     "comms_fused_block",
+    "offload_optimizer_default",
     "pp_microbatches",
     "pp_schedule",
     "tp_size",
+    "zero_stage_default",
 ]
 
 #: the comms spine's env knobs — aggregated by
@@ -108,6 +118,8 @@ COMMS_ENV_VARS = (
     "TPUFRAME_PP_MICROBATCHES",
     "TPUFRAME_PP_SCHEDULE",
     "TPUFRAME_TP_SIZE",
+    "TPUFRAME_ZERO_STAGE",
+    "TPUFRAME_OFFLOAD_OPTIMIZER",
 )
 
 #: value domains for the knobs above (KN007).  All "restart":
@@ -134,6 +146,9 @@ COMMS_ENV_DOMAINS = {
         "apply": "restart"},
     "TPUFRAME_TP_SIZE": {
         "type": "int", "range": (1, 64), "apply": "restart"},
+    "TPUFRAME_ZERO_STAGE": {
+        "type": "int", "range": (0, 3), "apply": "restart"},
+    "TPUFRAME_OFFLOAD_OPTIMIZER": {"type": "bool", "apply": "restart"},
 }
 
 #: wire formats the compressed collectives understand
@@ -353,3 +368,27 @@ def tp_size(environ: dict | None = None) -> int:
     except ValueError:
         val = 1
     return max(1, min(64, val))
+
+
+def zero_stage_default(environ: dict | None = None) -> int:
+    """``TPUFRAME_ZERO_STAGE`` resolved and clamped to [0, 3] (default 0
+    = pure DP); ``parallel.compose.compose`` reads it when the caller
+    doesn't pass ``zero_stage=`` explicitly — the memory-bound autotune
+    branch proposes its moves through this knob."""
+    env = os.environ if environ is None else environ
+    raw = str(env.get("TPUFRAME_ZERO_STAGE", "") or "").strip()
+    try:
+        val = int(raw) if raw else 0
+    except ValueError:
+        val = 0
+    return max(0, min(3, val))
+
+
+def offload_optimizer_default(environ: dict | None = None) -> bool:
+    """``TPUFRAME_OFFLOAD_OPTIMIZER`` as a bool (default off); the
+    ``compose(offload_optimizer=...)`` parameter wins when passed
+    explicitly.  The plan still downgrades loudly when the backend has
+    no addressable host memory space."""
+    env = os.environ if environ is None else environ
+    raw = str(env.get("TPUFRAME_OFFLOAD_OPTIMIZER", "") or "").strip().lower()
+    return raw not in _FALSY

@@ -48,7 +48,13 @@ from tpuframe.core.runtime import (
     SEQUENCE_AXIS,
     MeshSpec,
 )
-from tpuframe.parallel.comms_env import pp_microbatches, pp_schedule, tp_size
+from tpuframe.parallel.comms_env import (
+    offload_optimizer_default,
+    pp_microbatches,
+    pp_schedule,
+    tp_size,
+    zero_stage_default,
+)
 from tpuframe.parallel.sharding import ParallelPlan, Rule, mesh_axes
 
 __all__ = ["compose", "default_tp_rules", "pipeline_rules"]
@@ -85,12 +91,12 @@ def compose(
     tp: int | None = None,
     pp: int = 1,
     sp: int = 1,
-    zero_stage: int = 0,
+    zero_stage: int | None = None,
     microbatches: int | None = None,
     schedule: str | None = None,
     rules: Sequence[Rule] = (),
     min_shard_elems: int = 2**14,
-    offload_optimizer: bool = False,
+    offload_optimizer: bool | None = None,
     comms_groups: int | None = None,
     comms_fused: bool | None = None,
     devices: Any = None,
@@ -109,7 +115,10 @@ def compose(
         remainder; ``tp=None`` resolves ``TPUFRAME_TP_SIZE``, default 1).
       zero_stage: the DeepSpeed ladder (0..3) — stage 3 shards params
         over ``fsdp`` with gather-on-use; composes with ``tp``/``pp``
-        rules and with the compressed wire.
+        rules and with the compressed wire.  ``None`` resolves
+        ``TPUFRAME_ZERO_STAGE`` (default 0) — the knob the memory-bound
+        autotune branch moves; ``offload_optimizer=None`` likewise
+        resolves ``TPUFRAME_OFFLOAD_OPTIMIZER`` (default off).
       microbatches: pipeline microbatch pin (None resolves
         ``TPUFRAME_PP_MICROBATCHES``; 0/unset leaves the model default).
       schedule: pipeline interleave pin (None resolves
@@ -126,6 +135,10 @@ def compose(
 
     if tp is None:
         tp = tp_size()
+    if zero_stage is None:
+        zero_stage = zero_stage_default()
+    if offload_optimizer is None:
+        offload_optimizer = offload_optimizer_default()
     if mesh is None:
         mesh = MeshSpec(
             pipe=pp, data=dp, fsdp=fsdp, seq=sp, model=tp

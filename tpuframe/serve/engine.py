@@ -620,6 +620,12 @@ class ServeEngine:
                                      else self._jit(xd))
                 pool.release(lease, device_arrays=xd)
             except Exception as e:  # noqa: BLE001 - batch-scoped isolation
+                # OOM forensics first: a RESOURCE_EXHAUSTED on the infer
+                # path gets its memory/oom attribution event before the
+                # generic batch_error narration
+                from tpuframe.track.memory import maybe_oom_event
+
+                maybe_oom_event(e, where="serve/infer", step=bidx)
                 self._c_errors.inc()
                 tele.event("serve/batch_error", batch=bidx,
                            error=f"{type(e).__name__}: {e}"[:300])

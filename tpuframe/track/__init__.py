@@ -38,6 +38,14 @@ _EXPORTS = {
     "device_time_report": "device_time",
     "device_trace_events": "device_time",
     "profile_env": "device_time",
+    "MEMORY_ENV_VARS": "memory",
+    "MEMORY_ENV_DOMAINS": "memory",
+    "memory_env": "memory",
+    "record_executable_memory": "memory",
+    "executable_records": "memory",
+    "update_watermarks": "memory",
+    "maybe_oom_event": "memory",
+    "is_oom": "memory",
     "ExperimentTracker": "mlflow_store",
     "MLflowLogger": "mlflow_store",
     "Run": "mlflow_store",
@@ -75,6 +83,7 @@ _SUBMODULES = (
     "analyze",
     "device_time",
     "http_store",
+    "memory",
     "mlflow_store",
     "profiler",
     "registry",

@@ -737,17 +737,26 @@ def _serve_trace_info(ranks: Sequence[RankLog]) -> dict | None:
 # and update tpuframe/autotune + the golden structural test together).
 # 1.1: + device_time (parsed profiler capture)
 # 1.2: + serve_trace (per-hop request-path attribution + SLO scoring)
-SKEW_REPORT_VERSION = "1.2"
+# 1.3: + memory (watermarks, compiled executables, OOM forensics)
+SKEW_REPORT_VERSION = "1.3"
 
 # Top-level keys, always present (value may be None for the optional
 # blocks: time_to_first_step, health, comms, serve_latency, serve_trace,
-# device_time, slowest).
+# device_time, memory, slowest).
 SKEW_REPORT_KEYS = (
     "schema_version", "ranks", "hosts", "steps", "warmup_steps_skipped",
     "compile", "time_to_first_step", "health", "straggler_factor",
-    "comms", "serve_latency", "serve_trace", "device_time", "step_time",
-    "step_wall", "total_lost_s", "straggler_lost_s", "straggling_steps",
-    "lost_by_bound", "slowest", "per_rank", "per_step",
+    "comms", "serve_latency", "serve_trace", "device_time", "memory",
+    "step_time", "step_wall", "total_lost_s", "straggler_lost_s",
+    "straggling_steps", "lost_by_bound", "slowest", "per_rank", "per_step",
+)
+
+# Memory block keys (1.3) — built from memory/watermark,
+# memory/executable, and memory/oom events; the block is None when the
+# run emitted none of them (memory plane off = incomparable, not zero).
+SKEW_REPORT_MEMORY_KEYS = (
+    "hbm_peak_mb", "host_peak_mb", "hbm_limit_mb", "hbm_peak_util",
+    "peak_executable_mb", "executables", "ooms", "last_oom", "budget_mb",
 )
 
 # Row contracts for the two per-entity tables.
@@ -914,6 +923,53 @@ def skew_report(ranks: Sequence[RankLog], *,
                 "p99": round(_pctl(ar_durs, 0.99), 6),
             } if ar_durs else None,
         }
+    # memory block: present only when the memory plane left a trail —
+    # ratcheted memory/watermark events (live HBM/host peaks),
+    # memory/executable records (AOT compiled truth), or memory/oom
+    # forensics.  A run with the plane off keeps its report byte-stable.
+    memory_info = None
+    mem_execs: dict[str, float] = {}
+    mem_hbm = mem_host = mem_limit = 0.0
+    mem_ooms = 0
+    mem_last_oom = None
+    mem_budget = None
+    for rl in ranks:
+        for rec in rl.events:
+            name = rec.get("name")
+            if name == "memory/executable" and rec.get("label"):
+                mem_execs[rec["label"]] = float(rec.get("peak_mb") or 0.0)
+            elif name == "memory/watermark":
+                mem_hbm = max(mem_hbm, float(rec.get("hbm_peak_mb") or 0.0))
+                mem_host = max(mem_host, float(rec.get("host_peak_mb") or 0.0))
+                mem_limit = max(mem_limit, float(rec.get("hbm_limit_mb") or 0.0))
+            elif name == "memory/oom":
+                mem_ooms += 1
+                if rec.get("budget_mb"):
+                    mem_budget = rec["budget_mb"]
+                mem_last_oom = {
+                    "where": rec.get("where"),
+                    "step": rec.get("step"),
+                    "estimate_total_mb": rec.get("estimate_total_mb"),
+                    "suggestion": (rec.get("fit") or {}).get("suggestion"),
+                }
+    if mem_execs or mem_ooms or mem_hbm or mem_host:
+        peak_exec = max(mem_execs.values(), default=0.0)
+        memory_info = {
+            "hbm_peak_mb": round(mem_hbm, 3) or None,
+            "host_peak_mb": round(mem_host, 3) or None,
+            "hbm_limit_mb": round(mem_limit, 3) or None,
+            "hbm_peak_util": (
+                round(mem_hbm / mem_limit, 4) if mem_hbm and mem_limit
+                else None
+            ),
+            "peak_executable_mb": round(peak_exec, 3) or None,
+            "executables": {
+                label: round(v, 3) for label, v in sorted(mem_execs.items())
+            },
+            "ooms": mem_ooms,
+            "last_oom": mem_last_oom,
+            "budget_mb": mem_budget,
+        }
     worst = max(excess, key=lambda r: excess[r]) if excess else None
     # measured compile wall: the warmup skip exists because the first
     # step carries the compile — report WHAT it carried instead of
@@ -975,6 +1031,9 @@ def skew_report(ranks: Sequence[RankLog], *,
         # parsed profiler capture: per-class device wall, exposed comms,
         # the top-op table (baseline diffs on exposed/device-step)
         "device_time": _device_time_info(ranks),
+        # watermarks + compiled executables + OOM forensics (baseline
+        # diffs on ratio_peak_hbm)
+        "memory": memory_info,
         "step_time": step_time,          # dispatch-only (baseline diffs)
         "step_wall": {                   # boundary-to-boundary
             "p50": round(_pctl(walls, 0.50), 6) if walls else None,
@@ -1030,7 +1089,12 @@ def baseline_diff(report: dict, baseline: str, *,
     on the request path gates the same way.  Records carrying a
     ``serve_trace`` block (``bench_serve.py --fleet`` commits one) diff
     the per-hop queue-wait p99 (``ratio_queue_wait_p99``) and the SLO
-    burn rate (``ratio_burn_rate``) under the same discipline.  ``backend`` filters the baselines
+    burn rate (``ratio_burn_rate``) under the same discipline.  Records
+    carrying a ``memory`` block (``bench_memory.py`` commits one) diff
+    the peak HBM watermark — live when the backend reports device
+    stats, else the compiled ``peak_executable_mb`` — as
+    ``ratio_peak_hbm``: a plan whose footprint grew past threshold
+    gates exactly like a slower step (exit 3).  ``backend`` filters the baselines
     compared (``"cpu"``/``"tpu"``): without it a CPU run diffed against
     a results dir that also holds TPU records would read ~10x "slower"
     and trip the regression exit code spuriously — pass the backend the
@@ -1057,6 +1121,11 @@ def baseline_diff(report: dict, baseline: str, *,
     cur_qw = ((cur_st_block.get("hops") or {}).get("queue_wait")
               or {}).get("p99")
     cur_burn = (cur_st_block.get("slo") or {}).get("burn_rate")
+    cur_mem = report.get("memory") or {}
+    # live watermark when the backend reports device stats, else the
+    # compiled peak (CPU: memory_analysis works, memory_stats doesn't) —
+    # both sides of a diff commit the same shape
+    cur_hbm = cur_mem.get("hbm_peak_mb") or cur_mem.get("peak_executable_mb")
     out: dict = {"threshold": threshold, "backend": backend,
                  "baselines": [], "regressions": []}
     for p in paths:
@@ -1087,8 +1156,12 @@ def baseline_diff(report: dict, baseline: str, *,
             ((tr.get("hops") or {}).get("queue_wait") or {}).get("p99")
             or (tr.get("slo") or {}).get("burn_rate")
         ) else None
+        mm = rec.get("memory")
+        mm = mm if isinstance(mm, dict) and (
+            mm.get("hbm_peak_mb") or mm.get("peak_executable_mb")
+        ) else None
         if st is None and tt is None and sv is None and cm is None \
-                and dt is None and tr is None:
+                and dt is None and tr is None and mm is None:
             continue
         if backend and rec.get("backend") and rec["backend"] != backend:
             continue
@@ -1169,6 +1242,19 @@ def baseline_diff(report: dict, baseline: str, *,
                 entry["baseline_burn_rate"] = base_burn
                 entry["current_burn_rate"] = cur_burn
                 entry["ratio_burn_rate"] = round(cur_burn / base_burn, 4)
+        if mm is not None:
+            # memory regressions gate like step-time ones: the peak HBM
+            # watermark (or, backends without device stats, the compiled
+            # executable peak) growing past threshold means the plan's
+            # footprint ballooned — the capacity headroom the estimator
+            # promised eroded.  A run with NO memory block — plane off —
+            # is incomparable, not a regression, same discipline as
+            # comms/device_time.
+            base_hbm = mm.get("hbm_peak_mb") or mm.get("peak_executable_mb")
+            if base_hbm and cur_hbm:
+                entry["baseline_peak_hbm_mb"] = base_hbm
+                entry["current_peak_hbm_mb"] = cur_hbm
+                entry["ratio_peak_hbm"] = round(cur_hbm / base_hbm, 4)
         out["baselines"].append(entry)
         if (entry.get("ratio_p50") and entry["ratio_p50"] > threshold) or (
             entry.get("ratio_ttfs") and entry["ratio_ttfs"] > threshold
@@ -1193,6 +1279,9 @@ def baseline_diff(report: dict, baseline: str, *,
         ) or (
             entry.get("ratio_burn_rate")
             and entry["ratio_burn_rate"] > threshold
+        ) or (
+            entry.get("ratio_peak_hbm")
+            and entry["ratio_peak_hbm"] > threshold
         ):
             out["regressions"].append(entry)
     return out
@@ -1338,6 +1427,41 @@ def format_report(report: dict, diff: dict | None = None, *,
                     f"    {op['pct']:>5.1f} {op['total_s'] * 1e3:>10.2f} "
                     f"{op['count']:>6}  {op['name']} [{op['class']}]"
                 )
+    mem = report.get("memory") or {}
+    if mem:
+        parts = []
+        if mem.get("hbm_peak_mb"):
+            util = (
+                f" ({mem['hbm_peak_util']:.0%} of "
+                f"{mem['hbm_limit_mb']:.0f}MB)"
+                if mem.get("hbm_peak_util") else ""
+            )
+            parts.append(f"hbm peak {mem['hbm_peak_mb']:.1f}MB{util}")
+        if mem.get("host_peak_mb"):
+            parts.append(f"host peak {mem['host_peak_mb']:.1f}MB")
+        if mem.get("peak_executable_mb"):
+            parts.append(
+                f"compiled peak {mem['peak_executable_mb']:.1f}MB over "
+                f"{len(mem.get('executables') or {})} executable(s)"
+            )
+        lines.append("  memory: " + ", ".join(parts or ["(no samples)"]))
+        if mem.get("ooms"):
+            oom = mem.get("last_oom") or {}
+            sug = oom.get("suggestion") or {}
+            sug_txt = ""
+            if sug:
+                knobs = ", ".join(
+                    f"{k}={v}" for k, v in sug.items()
+                    if k in ("zero_stage", "microbatches", "offload_optimizer")
+                )
+                sug_txt = (
+                    f"; nearest fitting plan: {knobs} "
+                    f"(est {sug.get('total_mb', 0):.1f}MB)"
+                )
+            lines.append(
+                f"  OOM: {mem['ooms']} event(s), last at "
+                f"{oom.get('where')} step {oom.get('step')}" + sug_txt
+            )
     lines.append(
         f"  time lost to stragglers: {report['straggler_lost_s']:.3f}s "
         f"across {report['straggling_steps']} straggling step(s) "

@@ -67,9 +67,15 @@ class SystemMetricsMonitor:
         process-wide telemetry's).
     """
 
-    def __init__(self, run=None, interval_s: float = 10.0,
+    def __init__(self, run=None, interval_s: float | None = None,
                  prefix: str = "system/", registry=None):
         self.run = run
+        if interval_s is None:
+            # TPUFRAME_MEMORY_SAMPLE_S: the memory plane's watermark
+            # cadence doubles as the monitor default (one sampler)
+            from tpuframe.track.memory import memory_env
+
+            interval_s = memory_env()["TPUFRAME_MEMORY_SAMPLE_S"]
         self.interval_s = interval_s
         self.prefix = prefix
         self.registry = registry
@@ -109,6 +115,14 @@ class SystemMetricsMonitor:
         reg.gauge("system/rss_mb").set(rss)
         for k, v in devices.items():
             reg.gauge(f"system/{k}").set(v)
+        # memory plane: fold this sample into the process-wide HBM/host
+        # watermarks (memory/hbm_peak_mb, memory/host_peak_mb + the
+        # ratcheted memory/watermark event) — same sample, no second
+        # device poll
+        from tpuframe.track.memory import memory_env, update_watermarks
+
+        if memory_env()["TPUFRAME_MEMORY_LIVE"]:
+            update_watermarks(devices, rss, registry=reg)
         return metrics
 
     def _publish(self) -> None:

@@ -47,6 +47,7 @@ from tpuframe.fault import health as _health
 from tpuframe.fault import preempt as _preempt
 from tpuframe.fault.health import Divergence
 from tpuframe.fault.preempt import Preempted
+from tpuframe.track import memory as _memory
 from tpuframe.track.analyze import StragglerMonitor
 from tpuframe.track.telemetry import get_telemetry
 from tpuframe.parallel.precision import Policy, align_model_dtype, get_policy
@@ -878,6 +879,10 @@ class Trainer:
                     self._compiled[(kind, sig)] = compiled
                 entry["dispatchable"] = compiled is not None
             except Exception as e:
+                # an OOM during AOT compile gets the forensics event
+                # (estimate vs compiled vs live + fit suggestion); the
+                # precompile itself still degrades to lazy-compile
+                _memory.maybe_oom_event(e, where="precompile")
                 entry["error"] = f"{type(e).__name__}: {e}"[:300]
                 tele.event(
                     "compile/precompile_error", step_kind=kind,
@@ -1156,6 +1161,22 @@ class Trainer:
                         "tpuframe.launch.rederive_batch_split(global_batch="
                         f"{saved_gb}, dp_size={self.plan.dp_size})"
                     )
+        # memory-forensics context: register the plan + the live state's
+        # shape/dtype trees (the walker only reads attrs — nothing
+        # materializes) so an OOM anywhere in this fit can attribute
+        # bytes and suggest the nearest-fitting plan without recompiling
+        try:
+            batch_template = loader_batch_template(self, train=True)
+        except Exception:
+            batch_template = None
+        _memory.set_context(
+            plan=self.plan,
+            model_template=self.state.params,
+            batch_spec=batch_template,
+            opt_template=self.state.opt_state,
+            comms_template=self.state.comms,
+            microbatches=self.grad_accum,
+        )
         # divergence-recovery data-order skip: after a rollback the
         # supervisor may direct this attempt to re-enter PAST the poison
         # window instead of deterministically replaying into it.
@@ -1363,18 +1384,28 @@ class Trainer:
             if self._done() or self._stop_reason is not None:
                 break
             self._emit("on_step_start")
-            chaos.maybe_fire("step", step=self.batches_seen)
-            # the guard turns a wedged dispatch (first-step compile, stuck
-            # collective) into an attributed watchdog report instead of a
-            # silent hang; unmonitored unless a watchdog is configured.
-            # data_wait_s rides as a span attr so the fleet analyzer can
-            # classify this step input-bound without a second JSONL line.
-            with tele.span("train/step", batch=self.batches_seen,
-                           data_wait_s=round(wait_s, 6)) as sp, \
-                    tele.guard("train/step"):
-                self.state, metrics = self._step_call(
-                    "train", self._train_step, self.state, batch
-                )
+            try:
+                chaos.maybe_fire("step", step=self.batches_seen)
+                # the guard turns a wedged dispatch (first-step compile,
+                # stuck collective) into an attributed watchdog report
+                # instead of a silent hang; unmonitored unless a watchdog
+                # is configured.  data_wait_s rides as a span attr so the
+                # fleet analyzer can classify this step input-bound
+                # without a second JSONL line.
+                with tele.span("train/step", batch=self.batches_seen,
+                               data_wait_s=round(wait_s, 6)) as sp, \
+                        tele.guard("train/step"):
+                    self.state, metrics = self._step_call(
+                        "train", self._train_step, self.state, batch
+                    )
+            except Exception as e:
+                # OOM forensics: a RESOURCE_EXHAUSTED here (the chaos
+                # OomAt fires inside this block too) becomes one
+                # memory/oom event with the attribution table + fit
+                # suggestion; everything re-raises untouched
+                _memory.maybe_oom_event(e, where="step",
+                                        step=self.batches_seen)
+                raise
             dispatch += sp.elapsed
             self.batches_seen += 1
             self.samples_seen += self.train_dataloader.global_batch_size
