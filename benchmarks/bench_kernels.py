@@ -1,0 +1,511 @@
+#!/usr/bin/env python
+"""Kernel-ledger pricing benchmark: A/B every dispatchable op, prove the
+verdicts dispatch cleanly, and accept the fused MoE with a measured
+device-time round.
+
+Three phases, one committed record:
+
+1. **Pricing** — every op in ``ops.ledger.OPS_REGISTRY`` with a
+   single-shape microbench runs through ``price_op``: baseline is the
+   jnp reference (``TPUFRAME_KERNELS=off``), the kernel probes against
+   it under the never-commit-slower guard, and each tile knob probes a
+   small legal grid against the best committed config.  On a non-TPU
+   host the Pallas ops price in interpret mode (the only way the kernel
+   code runs here) — interpret is expected to LOSE, and the committed
+   ``enable=false`` verdicts are the ledger doing its job: removing
+   kernels it measured slower on this backend.  The fused MoE is pure
+   XLA, so its A/B is real on every backend.
+
+2. **Verdict fit** — the priced ledger persists (atomic, keyed
+   host/backend/signature), then the SAME short MoE-transformer fit
+   runs twice through the compile spine (``precompile_call`` +
+   ``ShapeGuard``): reference arm (``TPUFRAME_KERNELS=off``) vs ledger
+   arm (``TPUFRAME_KERNELS=auto`` reading the store just written).
+   Both arms are profiled and parsed by ``device_time_report``; the
+   committed record proves **zero** ``compile/recompile`` /
+   ``compile/aot_fallback`` events while dispatching off persisted
+   verdicts, and counts the ``ops/ledger_hit`` lookups that steered it.
+
+3. **MoE acceptance** — the fused scatter/gather dispatch/combine is
+   accepted only here: bit-close to the dense-einsum oracle on the
+   fit's own shapes (committed ``max_abs_diff`` vs the documented
+   atol), with before/after ``device_time`` blocks and exit **3** when
+   ``ratio_device_step`` (ledger arm / reference arm) regresses past
+   the guard — the same gate ``python -m tpuframe.track analyze
+   --baseline benchmarks/results/`` applies to every future run against
+   the committed ``device_time`` block.
+
+Usage: python benchmarks/bench_kernels.py [--json] [--steps N]
+       TPUFRAME_KERNEL_LEDGER_DIR=... python benchmarks/bench_kernels.py  # persist
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+#: the ratio_device_step guard for the MoE acceptance (CPU device-time
+#: medians carry a little noise; the analyzer gates committed baselines
+#: at its own threshold)
+GUARD = 1.05
+
+#: the fused-vs-oracle tolerance the moe_gating docstring pins (f32;
+#: scatter accumulation order vs einsum reduction order)
+MOE_ATOL = 1e-5
+
+# fit dims — small enough for a CPU tier-1-adjacent runtime, big enough
+# that the dense (kN, E, C) dispatch tensor visibly costs device time
+VOCAB, LAYERS, HEADS, HEAD_DIM, SEQ, BATCH = 64, 2, 2, 16, 64, 8
+EXPERTS, TOP_K = 4, 2
+D_MODEL = HEADS * HEAD_DIM
+
+
+def _walls(make_fn, args_, steps):
+    """Per-step walls of a freshly-jitted fn (fresh trace per call, so
+    the env overlay's dispatch decisions re-apply)."""
+    import jax
+
+    from tpuframe.ops import dispatch
+
+    dispatch._reset_kernel_cache()
+    fn = make_fn()
+    walls = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        out = fn(*args_)
+        jax.block_until_ready(out)
+        walls.append(time.perf_counter() - t0)
+    return walls
+
+
+def op_cases(steps):
+    """op -> (shape_class, run_fn, tile_grid) microbenches.
+
+    Shapes are one representative class per op; the MoE case matches
+    the verdict fit's token/expert dims exactly, so the fit's ledger
+    lookup hits the class priced here.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuframe.ops.cross_entropy import fused_cross_entropy
+    from tpuframe.ops.fused_adamw import fused_adamw_update
+    from tpuframe.ops.layer_norm import fused_layer_norm
+    from tpuframe.ops.ledger import shape_class
+    from tpuframe.ops.moe_gating import moe_dispatch_combine
+    from tpuframe.ops.normalize import normalize_images
+    from tpuframe.ops.quant_wire import bucket_abs_max, quant_encode
+
+    rng = np.random.default_rng(0)
+    f32 = lambda *s: jnp.asarray(  # noqa: E731
+        rng.standard_normal(s).astype(np.float32))
+
+    cases = {}
+
+    logits, labels = f32(256, 1024), jnp.asarray(
+        rng.integers(0, 1024, 256).astype(np.int32))
+    cases["cross_entropy"] = (
+        shape_class(b=256, k=1024),
+        lambda env: _walls(
+            lambda: jax.jit(lambda a, b: fused_cross_entropy(a, b)),
+            (logits, labels), steps),
+        {"TPUFRAME_KERNEL_CE_ROWS": (8, 32, 64)},
+    )
+
+    images = jnp.asarray(rng.integers(0, 256, (64, 32, 32, 3)).astype(np.uint8))
+    cases["normalize"] = (
+        shape_class(n=images.size),
+        lambda env: _walls(
+            lambda: jax.jit(lambda im: normalize_images(
+                im, (0.5, 0.5, 0.5), (0.25, 0.25, 0.25))),
+            (images,), steps),
+        {"TPUFRAME_KERNEL_NORM_TILE_ROWS": (64, 512, 1024)},
+    )
+
+    x, sc, bi = f32(512, 512), f32(512), f32(512)
+    cases["layer_norm"] = (
+        shape_class(d=512),
+        lambda env: _walls(
+            lambda: jax.jit(lambda a, b, c: fused_layer_norm(a, b, c)),
+            (x, sc, bi), steps),
+        {},
+    )
+
+    n_p = 1 << 16
+    p, g, m, v = f32(n_p), f32(n_p), f32(n_p), jnp.abs(f32(n_p))
+    step_t = jnp.asarray(3, jnp.int32)
+    cases["fused_adamw"] = (
+        shape_class(n=n_p),
+        lambda env: _walls(
+            lambda: jax.jit(lambda *a: fused_adamw_update(
+                *a, lr=1e-3, weight_decay=0.01)),
+            (p, g, m, v, step_t), steps),
+        {},
+    )
+
+    payload = f32(64, 4096)
+    amax = bucket_abs_max(payload)
+    cases["quant_wire"] = (
+        shape_class(buckets=64, elems=4096),
+        lambda env: _walls(
+            lambda: jax.jit(lambda a, b: quant_encode(a, b, "int8")),
+            (payload, amax), steps),
+        {},
+    )
+
+    n_tok = BATCH * SEQ
+    tokens = f32(n_tok, D_MODEL)
+    gv, gi = jax.lax.top_k(
+        jax.nn.softmax(f32(n_tok, EXPERTS)), TOP_K)
+    gv = gv / jnp.sum(gv, -1, keepdims=True)
+    w_in = f32(EXPERTS, D_MODEL, D_MODEL * 4) * 0.1
+    w_out = f32(EXPERTS, D_MODEL * 4, D_MODEL) * 0.1
+    capacity = max(1, int(-(-(TOP_K * n_tok) // EXPERTS) * 1.25))
+    cases["moe_gating"] = (
+        shape_class(n=n_tok, e=EXPERTS),
+        lambda env: _walls(
+            lambda: jax.jit(lambda t, a, b, wi, wo: moe_dispatch_combine(
+                t, a, b, wi, wo, capacity=capacity)),
+            (tokens, gv, gi, w_in, w_out), steps),
+        {},
+    )
+    return cases
+
+
+def price_all(store_dir: str, steps: int, say) -> tuple[dict, dict]:
+    """Phase 1: price every op, persist the ledger, return (record rows,
+    the saved ledger identity)."""
+    import jax
+
+    from tpuframe.ops.ledger import open_ledger, price_op, save_ledger
+
+    backend = jax.default_backend()
+    ledger = open_ledger(backend=backend,
+                         store_dir=store_dir)
+    rows = {}
+    for op, (cls, run_fn, grid) in op_cases(steps).items():
+        t0 = time.perf_counter()
+        v = price_op(ledger, op, cls, run_fn, tile_grid=grid)
+        say(f"priced {op} [{cls}]: off={v['p50_off_s']:.5f}s "
+            f"on={v['p50_on_s']:.5f}s ratio={v['ratio']} "
+            f"-> {'ON' if v['enable'] else 'off'} "
+            f"({time.perf_counter() - t0:.1f}s)")
+        rows[op] = {
+            "shape_class": cls,
+            "enable": v["enable"],
+            "p50_off_s": round(v["p50_off_s"], 6),
+            "p50_on_s": round(v["p50_on_s"], 6),
+            "p50_best_s": round(v["p50_best_s"], 6),
+            "ratio": v["ratio"],
+            "tile_env": v["env"],
+            "probes": [
+                {"env": p["env"], "p50_s": round(p["p50_s"], 6),
+                 "committed": p["committed"]}
+                for p in v["probes"]
+            ],
+        }
+    path = save_ledger(ledger, store_dir)
+    say(f"ledger persisted: {path}")
+    return rows, {"host": ledger.host, "backend": ledger.backend,
+                  "signature": ledger.signature}
+
+
+def make_fit():
+    """The MoE-transformer fit both arms share: model, identical init,
+    identical batches."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuframe.models import TransformerLM
+    from tpuframe.train import create_train_state
+
+    model = TransformerLM(
+        vocab_size=VOCAB, num_layers=LAYERS, num_heads=HEADS,
+        head_dim=HEAD_DIM, max_len=SEQ, attn_impl="full",
+        moe_experts=EXPERTS, moe_top_k=TOP_K,
+    )
+    rng = np.random.default_rng(0)
+    toks = [
+        jnp.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)).astype(np.int32))
+        for _ in range(32)
+    ]
+
+    def mk_state():
+        import optax
+
+        return create_train_state(
+            model, jax.random.PRNGKey(0), toks[0][:1], optax.adamw(1e-3))
+
+    return model, mk_state, toks
+
+
+def run_fit_arm(env, mk_state, toks, *, warmup, n_steps, label):
+    """One AOT-dispatched fit under ``env``: per-step walls, parsed
+    device-time, and the zero-recompile/zero-fallback proof."""
+    import jax
+
+    from tpuframe.autotune.probe import _env_overlay
+    from tpuframe.compile.precompile import (
+        ShapeGuard,
+        abstract_state,
+        batch_signature,
+        precompile_call,
+    )
+    from tpuframe.ops import dispatch
+    from tpuframe.track.device_time import device_time_report
+    from tpuframe.track.profiler import trace
+    from tpuframe.track.telemetry import get_telemetry
+    from tpuframe.train import make_train_step
+
+    tele = get_telemetry()
+    with _env_overlay(env):
+        dispatch._reset_kernel_cache()
+        hits0 = tele.registry.counter("ops/ledger_hit").value
+        miss0 = tele.registry.counter("ops/ledger_miss").value
+        recompiles0 = tele.registry.counter("compile/recompiles").value
+        step = make_train_step(donate=False)
+        state = mk_state()
+        batch0 = {"input": toks[0], "label": toks[0]}
+        compiled = precompile_call(
+            step, (abstract_state(state), batch0),
+            label=f"bench/kernels@{label}",
+        )
+        guard = ShapeGuard(tele)
+        guard.expect("train", batch_signature(batch0))
+        fallbacks = 0
+
+        def dispatch_step(state, batch):
+            nonlocal fallbacks
+            guard.check("train", batch_signature(batch))
+            if compiled is not None:
+                try:
+                    return compiled(state, batch)
+                except Exception as e:
+                    fallbacks += 1
+                    tele.event(
+                        "compile/aot_fallback", step_kind="train",
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
+            return step(state, batch)
+
+        for t in toks[:warmup]:
+            state, metrics = dispatch_step(state, {"input": t, "label": t})
+            jax.block_until_ready(metrics)
+        walls = []
+        logdir = tempfile.mkdtemp(prefix=f"tpuframe_kernels_{label}_")
+        with trace(logdir):
+            for t in toks[warmup:warmup + n_steps]:
+                t0 = time.perf_counter()
+                state, metrics = dispatch_step(
+                    state, {"input": t, "label": t})
+                jax.block_until_ready(metrics)
+                walls.append(time.perf_counter() - t0)
+            jax.block_until_ready(state)
+        dt = device_time_report(logdir, steps=n_steps) or {}
+        dt["trace_dir"] = None  # temp dir: gone by the time anyone reads this
+        shutil.rmtree(logdir, ignore_errors=True)
+        dispatch._reset_kernel_cache()
+    s = sorted(walls)
+    return {
+        "state": state,
+        "walls": walls,
+        "device_time": dt,
+        "step_time": {
+            "p50": round(statistics.median(s), 6),
+            "p95": round(s[max(0, int(len(s) * 0.95) - 1)], 6),
+            "count": len(s),
+        },
+        "recompile_events": int(
+            tele.registry.counter("compile/recompiles").value - recompiles0
+        ),
+        "aot_fallback_events": fallbacks,
+        "aot_dispatch": compiled is not None,
+        "ledger_hits": int(
+            tele.registry.counter("ops/ledger_hit").value - hits0
+        ),
+        "ledger_misses": int(
+            tele.registry.counter("ops/ledger_miss").value - miss0
+        ),
+    }
+
+
+def moe_parity() -> dict:
+    """The acceptance parity: fused vs dense oracle on the fit's shapes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuframe.ops.moe_gating import (
+        moe_dispatch_combine,
+        moe_dispatch_combine_reference,
+    )
+
+    rng = np.random.default_rng(11)
+    n_tok = BATCH * SEQ
+    f32 = lambda *s: jnp.asarray(  # noqa: E731
+        rng.standard_normal(s).astype(np.float32))
+    tokens = f32(n_tok, D_MODEL)
+    gv, gi = jax.lax.top_k(jax.nn.softmax(f32(n_tok, EXPERTS)), TOP_K)
+    gv = gv / jnp.sum(gv, -1, keepdims=True)
+    w_in = f32(EXPERTS, D_MODEL, D_MODEL * 4) * 0.1
+    w_out = f32(EXPERTS, D_MODEL * 4, D_MODEL) * 0.1
+    capacity = max(1, int(-(-(TOP_K * n_tok) // EXPERTS) * 1.25))
+    want = moe_dispatch_combine_reference(
+        tokens, gv, gi, w_in, w_out, capacity=capacity)
+    got = moe_dispatch_combine(
+        tokens, gv, gi, w_in, w_out, capacity=capacity, fused=True)
+    diff = float(jnp.max(jnp.abs(got - want)))
+    return {
+        "max_abs_diff": diff,
+        "atol": MOE_ATOL,
+        "bit_close": diff <= MOE_ATOL,
+        "tokens": n_tok,
+        "experts": EXPERTS,
+        "capacity": capacity,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=12,
+                    help="timed steps per fit arm")
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable only: suppress stderr narration")
+    args = ap.parse_args()
+
+    def say(msg: str) -> None:
+        if not args.json:
+            print(msg, file=sys.stderr)
+
+    import jax
+
+    from tpuframe.autotune.probe import probe_steps, warmup_steps
+    from tpuframe.track import telemetry as T
+
+    backend = jax.default_backend()
+    persisted = bool(os.environ.get("TPUFRAME_KERNEL_LEDGER_DIR", "").strip())
+    tmp_store = None
+    if persisted:
+        store_dir = None  # the real ledger store the env points at
+    else:
+        tmp_store = tempfile.mkdtemp(prefix="tpuframe_bench_kernels_")
+        store_dir = tmp_store
+    interp = backend != "tpu"
+    if interp:
+        # only way the Pallas kernel code runs on this backend; the
+        # A/B then honestly prices interpret vs reference
+        os.environ["TPUFRAME_PALLAS_INTERPRET"] = "1"
+
+    tele_dir = tempfile.mkdtemp(prefix="tpuframe_bench_kernels_tele_")
+    try:
+        T.configure(jsonl_dir=tele_dir, rank=0)
+        micro_steps = probe_steps() + warmup_steps()
+        ops, identity = price_all(store_dir, micro_steps, say)
+
+        if interp:
+            os.environ.pop("TPUFRAME_PALLAS_INTERPRET", None)
+
+        # phase 2/3: the verdict fit, reference arm vs ledger arm
+        _model, mk_state, toks = make_fit()
+        ledger_env = {
+            "TPUFRAME_KERNELS": "auto",
+            "TPUFRAME_KERNEL_LEDGER_DIR":
+                store_dir or os.environ["TPUFRAME_KERNEL_LEDGER_DIR"],
+        }
+        say("fit: reference arm (TPUFRAME_KERNELS=off)…")
+        ref = run_fit_arm({"TPUFRAME_KERNELS": "off"}, mk_state, toks,
+                          warmup=args.warmup, n_steps=args.steps,
+                          label="off")
+        say("fit: ledger arm (TPUFRAME_KERNELS=auto, persisted verdicts)…")
+        led = run_fit_arm(ledger_env, mk_state, toks,
+                          warmup=args.warmup, n_steps=args.steps,
+                          label="auto")
+        parity = moe_parity()
+        T.reset()
+    finally:
+        shutil.rmtree(tele_dir, ignore_errors=True)
+        if tmp_store:
+            shutil.rmtree(tmp_store, ignore_errors=True)
+
+    ref_dstep = ref["device_time"].get("device_step_s")
+    led_dstep = led["device_time"].get("device_step_s")
+    ratio_dstep = (round(led_dstep / ref_dstep, 4)
+                   if ref_dstep and led_dstep else None)
+    ratio_p50 = (round(led["step_time"]["p50"] / ref["step_time"]["p50"], 4)
+                 if ref["step_time"]["p50"] > 0 else None)
+    clean_dispatch = (
+        led["recompile_events"] == 0 and led["aot_fallback_events"] == 0
+        and ref["recompile_events"] == 0 and ref["aot_fallback_events"] == 0
+    )
+    accepted = (
+        parity["bit_close"]
+        and clean_dispatch
+        and ratio_dstep is not None
+        and ratio_dstep <= GUARD
+    )
+
+    rec = {
+        "metric": "kernel_ledger_round",
+        "value": ratio_dstep,
+        "unit": "ledger-arm device_step_s / reference-arm device_step_s "
+                f"(<= {GUARD} accepts the fused MoE)",
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "ledger": identity,
+        "pallas_interpret_priced": interp,
+        "ops": ops,
+        "moe": {
+            "parity": parity,
+            "ratio_device_step": ratio_dstep,
+            "ratio_step_p50": ratio_p50,
+            "reference": {
+                "step_time": ref["step_time"],
+                "device_time": ref["device_time"],
+                "recompile_events": ref["recompile_events"],
+                "aot_fallback_events": ref["aot_fallback_events"],
+                "aot_dispatch": ref["aot_dispatch"],
+            },
+            "ledger_arm": {
+                "step_time": led["step_time"],
+                "device_time": led["device_time"],
+                "recompile_events": led["recompile_events"],
+                "aot_fallback_events": led["aot_fallback_events"],
+                "aot_dispatch": led["aot_dispatch"],
+                "ledger_hits": led["ledger_hits"],
+                "ledger_misses": led["ledger_misses"],
+            },
+        },
+        # analyzer-gateable blocks: the ledger arm is the baseline
+        # anchor future runs ratio against (ratio_p50 /
+        # ratio_device_step, exit 3 past threshold)
+        "step_time": led["step_time"],
+        "device_time": led["device_time"],
+        "fit": {"steps": args.steps, "warmup": args.warmup,
+                "tokens_per_step": BATCH * SEQ, "experts": EXPERTS,
+                "top_k": TOP_K, "d_model": D_MODEL, "layers": LAYERS},
+        "accepted": accepted,
+        "persisted": persisted,
+        "store": (os.environ.get("TPUFRAME_KERNEL_LEDGER_DIR")
+                  if persisted else "(tmp, discarded)"),
+    }
+    print(json.dumps(rec, indent=1))
+    if not accepted:
+        say(f"GATE: accepted={accepted} (bit_close={parity['bit_close']} "
+            f"clean_dispatch={clean_dispatch} ratio={ratio_dstep})")
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -138,6 +138,14 @@ run check_offload_tpu.json     600  python benchmarks/check_offload_tpu.py
 run bench_e2e_tpu.json         900  python benchmarks/bench_e2e.py
 run bench_e2e_tpu_uint8.json   900  python benchmarks/bench_e2e.py --uint8-input
 
+# kernel-ledger rung: A/B-price every dispatchable kernel (and its tile
+# grid) on real Mosaic and persist the verdicts into this host's ledger
+# store — the chip edition of the committed bench_kernels_cpu.json,
+# where the Pallas ops stop pricing in interpret mode and the verdict
+# table means something; high value because every later fit on this
+# host dispatches off whatever this rung persists
+run bench_kernels.json         600  python benchmarks/bench_kernels.py --json
+
 # fault-recovery rung: injected kill -> supervised restart -> measured
 # recovery wall-time + sync/async checkpoint-stall overhead — on the TPU
 # host this prices the real restore+recompile cost and the async_save
@@ -288,6 +296,15 @@ run bench_decode_scaling.json  600  python benchmarks/bench_decode.py \
 
 # full kernel ladder (blockwise/ring attention included)
 run check_kernels_tpu.json     900  python benchmarks/check_kernels_tpu.py
+
+# attention-family rung: full vs blockwise vs ring vs ulysses through
+# the REAL AOT-dispatched step at production seq lengths — persists the
+# per-seq-class `choice` verdicts attn_impl="auto" dispatches on
+# (bench_attention_cpu.json is the interpret-mode stand-in; this rung
+# replaces the heuristic _BLOCKWISE_AUTO_LEN crossover with measured
+# Mosaic numbers)
+run bench_attention.json       900  python benchmarks/bench_attention.py \
+  --seqs 1024,4096,8192 --json
 
 # LM tokens/s + MFU incl. the seq-8192 blockwise flash path — turns the
 # "98k tok/s / 4.2x long-context" PERF.md prose into committed JSON

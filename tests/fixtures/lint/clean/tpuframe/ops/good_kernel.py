@@ -1,0 +1,9 @@
+"""A dispatchable kernel with an oracle — registered, so OP001 is quiet."""
+
+
+def good_reference(x):
+    return [v * 2 for v in x]
+
+
+def fused_good(x):
+    return [v + v for v in x]

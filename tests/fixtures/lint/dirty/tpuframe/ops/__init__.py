@@ -1,0 +1,1 @@
+"""Kernel ops package (dirty fixture)."""

@@ -1,0 +1,5 @@
+"""Registered kernel whose registry row has gone stale (OP002/OP003)."""
+
+
+def fused_listed_renamed(x):  # the registry still claims "fused_listed"
+    return x

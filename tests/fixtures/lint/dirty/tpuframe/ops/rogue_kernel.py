@@ -1,0 +1,6 @@
+"""OP001: a kernel module that never made it into OPS_REGISTRY —
+invisible to TPUFRAME_KERNELS dispatch and the pricing bench."""
+
+
+def fused_rogue(x):
+    return x

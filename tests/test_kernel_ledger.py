@@ -1,0 +1,431 @@
+"""The kernel-profitability ledger: name map, shape classes, pricing,
+persistence, and the dispatch plane that consumes the verdicts.
+
+Covers the profiler→kernel loop end to end without a chip: raw profiler
+op names normalize to dispatchable ops (and the autotune diagnosis
+prints the normalized names), ``price_op`` never commits a slower
+kernel and clamps tile probes to the registry domain, verdicts persist
+atomically and reload only for the identity that wrote them, and
+``kernel_enabled``/``attention_choice`` consult the persisted store
+with one loud ``ops/kernel_verdict`` event per distinct decision.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpuframe.ops import dispatch
+from tpuframe.ops.ledger import (
+    ATTENTION_OP,
+    DEFAULT_SIGNATURE,
+    KERNEL_ENV_DOMAINS,
+    KERNEL_ENV_VARS,
+    OPS_REGISTRY,
+    KernelLedger,
+    attention_choice,
+    attn_block,
+    ce_rows,
+    kernels_mode,
+    list_ledgers,
+    load_ledger,
+    map_op_name,
+    norm_tile_rows,
+    normalize_top_ops,
+    open_ledger,
+    price_attention,
+    price_op,
+    save_ledger,
+    shape_class,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_env(monkeypatch):
+    """Isolate every test from ambient knob/cache state."""
+    for var in KERNEL_ENV_VARS:
+        monkeypatch.delenv(var, raising=False)
+    dispatch._reset_kernel_cache()
+    yield
+    dispatch._reset_kernel_cache()
+
+
+# -- shape classes & knobs ----------------------------------------------------
+
+
+def test_shape_class_rounds_up_and_sorts():
+    assert shape_class(b=200, k=1000) == "b256_k1024"
+    assert shape_class(n=512, e=4) == "e4_n512"  # keys sorted, not given order
+    assert shape_class(l=8192) == "l8192"  # exact powers stay put
+    assert shape_class(n=0) == "n1"  # degenerate dims clamp to 1
+
+
+def test_shape_class_symbolic_dims_degrade_to_none():
+    """Under jax.export shape polymorphism, batch dims are symbolic and
+    refuse int() — shape_class must hand dispatch its shape-agnostic
+    None, not abort the export trace (the serve-export regression)."""
+    from jax.export import symbolic_shape
+
+    (b,) = symbolic_shape("b")
+    assert shape_class(n=784 * b) is None
+    assert shape_class(n=784 * b, k=32) is None  # one bad dim poisons all
+
+
+def test_tile_knobs_clamp_and_align(monkeypatch):
+    assert ce_rows() == 16 and norm_tile_rows() == 256 and attn_block() == 512
+    monkeypatch.setenv("TPUFRAME_KERNEL_CE_ROWS", "1000000")
+    assert ce_rows() == 256  # clamped to the domain hi
+    monkeypatch.setenv("TPUFRAME_KERNEL_CE_ROWS", "3")
+    assert ce_rows() == 8  # clamped to lo
+    monkeypatch.setenv("TPUFRAME_KERNEL_CE_ROWS", "31")
+    assert ce_rows() == 24  # rounded DOWN to the sublane multiple
+    monkeypatch.setenv("TPUFRAME_KERNEL_ATTN_BLOCK", "notanint")
+    assert attn_block() == 512  # garbage reads as the default
+
+
+def test_kernels_mode_defaults_to_auto(monkeypatch):
+    assert kernels_mode() == "auto"
+    monkeypatch.setenv("TPUFRAME_KERNELS", "OFF")
+    assert kernels_mode() == "off"
+    monkeypatch.setenv("TPUFRAME_KERNELS", "banana")
+    assert kernels_mode() == "auto"  # illegal value degrades to auto
+
+
+def test_kernel_knobs_registered_fleet_wide():
+    """KN007 runtime mirror: every kernel knob ships to fleet ranks and
+    has a clamp domain the tile probes respect."""
+    from tpuframe.autotune.config import all_env_domains
+    from tpuframe.launch.remote import all_env_vars
+
+    shipped = all_env_vars()
+    domains = all_env_domains()
+    for var in KERNEL_ENV_VARS:
+        assert var in shipped
+        assert var in domains
+    assert domains["TPUFRAME_KERNELS"]["choices"] == ("auto", "on", "off")
+    assert KERNEL_ENV_DOMAINS["TPUFRAME_KERNEL_CE_ROWS"]["range"] == (8, 256)
+
+
+# -- profiler-name map --------------------------------------------------------
+
+
+def test_map_op_name_pins_fusion_roots():
+    assert map_op_name("log_softmax_fusion") == "cross_entropy"
+    assert map_op_name("layer_norm.clone") == "layer_norm"
+    assert map_op_name("jit_adamw_step") == "fused_adamw"
+    assert map_op_name("flash_fwd") == ATTENTION_OP
+    assert map_op_name("expert_dispatch_einsum") == "moe_gating"
+    assert map_op_name("fusion.123") is None  # generic names map to nothing
+    assert map_op_name("") is None and map_op_name(None) is None
+
+
+def test_normalize_top_ops_keeps_raw_and_rewrites_name():
+    rows = normalize_top_ops([
+        {"name": "log_softmax_fusion", "pct": 41.0, "class": "compute"},
+        {"name": "fusion.7", "pct": 12.0, "class": "compute"},
+    ])
+    assert rows[0]["op"] == "cross_entropy"
+    assert rows[0]["name"] == "cross_entropy"  # the actionable name
+    assert rows[0]["raw"] == "log_softmax_fusion"  # provenance kept
+    assert rows[1]["op"] is None
+    assert rows[1]["name"] == "fusion.7"  # unmapped rows keep their raw name
+
+
+def test_diagnosis_prints_dispatchable_ops_not_hlo_names():
+    """Satellite fix: a compute-bound diagnosis's top_ops detail must
+    name tpuframe ops (ledger-normalized), and a row the map pins to a
+    dispatchable op must produce the TPUFRAME_KERNELS=auto move."""
+    from tpuframe.autotune.diagnosis import diagnose
+
+    report = {
+        "step_time": {"mean": 0.1, "count": 20, "p50": 0.1},
+        "per_step": [{"bound": "compute"}] * 20,
+        "device_time": {
+            "device_step_s": 0.095,
+            "exposed_comms_per_step_s": 0.0,
+            "top_ops": [
+                {"name": "log_softmax_fusion", "pct": 38.0,
+                 "class": "compute"},
+                {"name": "layer_norm.clone.2", "pct": 21.0,
+                 "class": "compute"},
+                {"name": "fusion.9", "pct": 4.0, "class": "compute"},
+            ],
+        },
+    }
+    diag = diagnose(report)
+    assert diag.bound == "compute"
+    top = diag.detail["top_ops"]
+    assert [r["name"] for r in top[:2]] == ["cross_entropy", "layer_norm"]
+    assert top[0]["raw"] == "log_softmax_fusion"
+    kernel_moves = [m for m in diag.moves if m.knob == "TPUFRAME_KERNELS"]
+    assert kernel_moves and kernel_moves[0].value == "auto"
+    assert "cross_entropy" in kernel_moves[0].reason
+    assert "log_softmax_fusion" not in kernel_moves[0].reason
+
+
+# -- persistence --------------------------------------------------------------
+
+
+def test_ledger_round_trip(tmp_path):
+    led = open_ledger(backend="cpu", store_dir=str(tmp_path))
+    led.record("layer_norm", "d512", {"enable": False, "ratio": 3.2})
+    path = save_ledger(led, str(tmp_path))
+    assert os.path.exists(path)
+    back = load_ledger(led.host, "cpu", DEFAULT_SIGNATURE, str(tmp_path))
+    assert back is not None
+    assert back.verdict("layer_norm", "d512") == {"enable": False,
+                                                  "ratio": 3.2}
+    assert back.verdict("layer_norm", "d1024") is None
+    assert [l.signature for l in list_ledgers(str(tmp_path))] == ["unplanned"]
+    # open_ledger on the same identity resumes the persisted verdicts
+    again = open_ledger(backend="cpu", store_dir=str(tmp_path))
+    assert again.verdict("layer_norm", "d512")["enable"] is False
+
+
+def test_ledger_reads_are_tolerant(tmp_path):
+    led = KernelLedger(host="h", backend="cpu", signature="s")
+    path = save_ledger(led, str(tmp_path))
+    # corrupt JSON reads as "no ledger", never raises
+    with open(path, "w") as f:
+        f.write("{truncated")
+    assert load_ledger("h", "cpu", "s", str(tmp_path)) is None
+    assert list_ledgers(str(tmp_path)) == []
+    # an identity mismatch (hash collision / hand-edited file) is refused
+    with open(path, "w") as f:
+        json.dump({"host": "OTHER", "backend": "cpu", "signature": "s",
+                   "verdicts": {}, "created_unix": 1.0}, f)
+    assert load_ledger("h", "cpu", "s", str(tmp_path)) is None
+    # a missing store is fine too
+    assert load_ledger("h", "cpu", "s", str(tmp_path / "nope")) is None
+
+
+# -- pricing ------------------------------------------------------------------
+
+
+def _fake_runner(p50_by_env):
+    """run_fn(env) whose walls depend on the probe env — the test's
+    stand-in for a kernel that wins/loses per configuration."""
+    def run(env):
+        mode = env.get("TPUFRAME_KERNELS", "off")
+        tile = env.get("TPUFRAME_KERNEL_CE_ROWS", "")
+        key = (mode, tile) if (mode, tile) in p50_by_env else mode
+        return [p50_by_env[key]] * 10
+    return run
+
+
+def test_price_op_never_commits_slower():
+    led = KernelLedger(host="h", backend="cpu", signature="s")
+    v = price_op(led, "layer_norm", "d512",
+                 _fake_runner({"off": 0.001, "on": 0.004}))
+    assert v["enable"] is False
+    assert v["env"] == {}
+    assert v["ratio"] == 4.0
+    assert led.verdict("layer_norm", "d512")["enable"] is False
+    # losing probes still leave an audit trail
+    assert v["probes"][0]["committed"] is False
+
+
+def test_price_op_commits_winner_and_tunes_tiles():
+    led = KernelLedger(host="h", backend="cpu", signature="s")
+    v = price_op(
+        led, "cross_entropy", "b256_k1024",
+        _fake_runner({
+            "off": 0.010,
+            "on": 0.005,               # kernel wins at the default tile
+            ("on", "64"): 0.003,       # and the 64-row tile wins again
+            ("on", "8"): 0.009,        # the 8-row tile loses -> not kept
+        }),
+        tile_grid={"TPUFRAME_KERNEL_CE_ROWS": (8, 64, 999999)},
+    )
+    assert v["enable"] is True
+    assert v["env"] == {"TPUFRAME_KERNEL_CE_ROWS": "64"}
+    assert v["p50_best_s"] == 0.003
+    # the illegal grid value was clamped into the domain (999999 -> 256),
+    # probed as a legal value, and lost
+    probed = [p["env"].get("TPUFRAME_KERNEL_CE_ROWS") for p in v["probes"]]
+    assert "256" in probed and "999999" not in probed
+
+
+def test_price_attention_excludes_sharded_variants():
+    led = KernelLedger(host="h", backend="cpu", signature="s")
+    v = price_attention(led, "l8192", {
+        "full": lambda env: [0.030] * 10,
+        "blockwise": lambda env: [0.020] * 10,
+        "ring": lambda env: [0.010] * 10,       # fastest, but needs a mesh
+        "ulysses": lambda env: (_ for _ in ()).throw(RuntimeError("no mesh")),
+    })
+    # ring is recorded for the record but an unsharded auto can't take it
+    assert v["choice"] == "blockwise"
+    assert v["p50_s"]["ring"] == 0.010
+    assert "no mesh" in v["errors"]["ulysses_error"]
+    assert led.verdict(ATTENTION_OP, "l8192")["choice"] == "blockwise"
+
+
+# -- the dispatch plane -------------------------------------------------------
+
+
+def _store_with_verdicts(tmp_path, backend, verdicts):
+    led = open_ledger(backend=backend, store_dir=str(tmp_path))
+    for op, classes in verdicts.items():
+        for cls, v in classes.items():
+            led.record(op, cls, v)
+    save_ledger(led, str(tmp_path))
+    return str(tmp_path)
+
+
+def test_kernel_enabled_modes(monkeypatch, tmp_path):
+    import jax
+
+    backend = jax.default_backend()
+    store = _store_with_verdicts(tmp_path, backend, {
+        "layer_norm": {"d512": {"enable": False, "ratio": 3.0}},
+        "moe_gating": {"e4_n512": {"enable": True, "ratio": 0.4}},
+    })
+    monkeypatch.setenv("TPUFRAME_KERNEL_LEDGER_DIR", store)
+
+    monkeypatch.setenv("TPUFRAME_KERNELS", "off")
+    assert dispatch.kernel_enabled("layer_norm", "d512") is False
+    monkeypatch.setenv("TPUFRAME_KERNELS", "on")
+    assert dispatch.kernel_enabled("layer_norm", "d512") is True
+
+    monkeypatch.setenv("TPUFRAME_KERNELS", "auto")
+    assert dispatch.kernel_enabled("layer_norm", "d512") is False  # priced off
+    assert dispatch.kernel_enabled("moe_gating", "e4_n512") is True
+    assert dispatch.kernel_enabled("layer_norm", "d4096") is True  # unpriced
+    # shape-agnostic consult falls back to any recorded class verdict
+    assert dispatch.kernel_enabled("layer_norm") is False
+
+
+def test_kernel_enabled_without_store_defaults_on(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUFRAME_KERNEL_LEDGER_DIR", str(tmp_path / "empty"))
+    monkeypatch.setenv("TPUFRAME_KERNELS", "auto")
+    # pre-ledger behavior is the default: the ledger only ever REMOVES
+    # kernels it measured slower
+    assert dispatch.kernel_enabled("layer_norm", "d512") is True
+
+
+def test_verdict_event_fires_once_per_decision(monkeypatch, tmp_path):
+    from tpuframe.track import telemetry as T
+
+    import jax
+
+    backend = jax.default_backend()
+    store = _store_with_verdicts(tmp_path, backend, {
+        "layer_norm": {"d512": {"enable": False}},
+    })
+    monkeypatch.setenv("TPUFRAME_KERNEL_LEDGER_DIR", store)
+    monkeypatch.setenv("TPUFRAME_KERNELS", "auto")
+    tele = T.configure(str(tmp_path / "events.jsonl"))
+    try:
+        for _ in range(5):
+            dispatch.kernel_enabled("layer_norm", "d512")
+            dispatch.kernel_enabled("layer_norm", "d4096")
+        events = [e for e in tele.recent_events(50)
+                  if e["name"] == "ops/kernel_verdict"]
+        # one loud event per DISTINCT decision, not one per trace
+        assert len(events) == 2
+        by_cls = {e["shape_class"]: e for e in events}
+        assert by_cls["d512"]["enable"] is False
+        assert by_cls["d512"]["source"] == "ledger"
+        assert by_cls["d4096"]["enable"] is True
+        assert by_cls["d4096"]["source"] == "default"
+        assert tele.registry.counter("ops/ledger_hit").value == 1
+        assert tele.registry.counter("ops/ledger_miss").value == 1
+        # re-pricing resets the dedup: the decision may be re-announced
+        dispatch._reset_kernel_cache()
+        dispatch.kernel_enabled("layer_norm", "d512")
+        events = [e for e in tele.recent_events(50)
+                  if e["name"] == "ops/kernel_verdict"]
+        assert len(events) == 3
+    finally:
+        T.reset()
+
+
+def test_attention_choice_reads_persisted_verdict(monkeypatch, tmp_path):
+    import jax
+
+    backend = jax.default_backend()
+    store = _store_with_verdicts(tmp_path, backend, {
+        ATTENTION_OP: {
+            "l8192": {"choice": "blockwise", "p50_s": {}},
+            "l256": {"choice": "ring", "p50_s": {}},  # illegal for unsharded
+        },
+    })
+    monkeypatch.setenv("TPUFRAME_KERNEL_LEDGER_DIR", store)
+    assert attention_choice(8192) == "blockwise"
+    assert attention_choice(5000) == "blockwise"  # rounds up into l8192
+    # a choice auto can't dispatch unsharded falls back to the heuristic
+    assert attention_choice(256) is None
+    assert attention_choice(64) is None  # no verdict at all
+
+
+def test_attention_auto_dispatches_measured_choice(monkeypatch, tmp_path):
+    """The model-level loop: attn_impl='auto' on an unsharded sequence
+    takes the persisted measured choice, not the static length
+    heuristic."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuframe.models.transformer import SelfAttention
+
+    backend = jax.default_backend()
+    # seq 32 would be 'full' under the static heuristic; the ledger says
+    # the measured winner for this class is blockwise
+    store = _store_with_verdicts(tmp_path, backend, {
+        ATTENTION_OP: {"l32": {"choice": "blockwise", "p50_s": {}}},
+    })
+    monkeypatch.setenv("TPUFRAME_KERNEL_LEDGER_DIR", store)
+    x = jnp.ones((2, 32, 16), jnp.float32)
+    attn = SelfAttention(num_heads=2, head_dim=8, attn_impl="auto")
+    params = attn.init(jax.random.PRNGKey(0), x)
+    out = attn.apply(params, x)
+    assert out.shape == (2, 32, 16)
+    # the dispatch decision itself is observable: the verdict event fired
+    assert any(k[0] == ATTENTION_OP and k[1] == "l32"
+               for k in dispatch._VERDICT_EMITTED)
+
+
+# -- registry & doctor --------------------------------------------------------
+
+
+def test_registry_rows_resolve_and_have_parity_tests():
+    """Runtime mirror of lint OP002/OP003: every registry row resolves
+    to importable symbols and an existing parity test."""
+    import importlib
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for op, entry in OPS_REGISTRY.items():
+        mod = importlib.import_module(entry["module"])
+        assert hasattr(mod, entry["symbol"]), (op, entry["symbol"])
+        if entry["reference"] is not None:
+            assert hasattr(mod, entry["reference"]), (op, entry["reference"])
+        path, _, rest = entry["parity_test"].partition("::")
+        test_name = rest.split("::")[-1]
+        abspath = os.path.join(repo_root, path)
+        assert os.path.exists(abspath), (op, path)
+        with open(abspath) as f:
+            assert f"def {test_name}" in f.read(), (op, test_name)
+
+
+def test_doctor_kernels_section(monkeypatch, tmp_path):
+    import jax
+
+    from tpuframe.doctor import kernels_section
+
+    backend = jax.default_backend()
+    store = _store_with_verdicts(tmp_path, backend, {
+        "layer_norm": {"d512": {"enable": False, "ratio": 3.0,
+                                "env": {}}},
+    })
+    monkeypatch.setenv("TPUFRAME_KERNEL_LEDGER_DIR", store)
+    sec = kernels_section({"backend": backend})
+    assert sec["mode"] == "auto"
+    assert sec["registry"] == sorted(OPS_REGISTRY)
+    assert sec["tiles"]["TPUFRAME_KERNEL_CE_ROWS"] == 16
+    assert sec["store"] == store
+    (led,) = sec["ledgers"]
+    assert led["backend"] == backend
+    assert led["verdicts"]["layer_norm"]["d512"]["enable"] is False
+    assert "bench_kernels" in sec["price"]

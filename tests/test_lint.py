@@ -60,7 +60,7 @@ def test_repo_tree_has_zero_findings():
     )
     # and the pass actually looked at the tree
     assert result.files_scanned > 50
-    assert result.rules_run == 17
+    assert result.rules_run == 20
 
 
 def test_seeded_violation_in_real_module_flips_red(tmp_path):
@@ -101,6 +101,7 @@ def test_dirty_fixture_fires_every_rule_family(dirty):
         "TS001", "TS002",
         "CS001", "CS002", "CS003",
         "HP001", "HP002", "HP003",
+        "OP001", "OP002", "OP003",
     }
 
 
@@ -198,11 +199,71 @@ def test_hotpath_rules(dirty):
     )
 
 
+def test_ops_registry_rules(dirty):
+    by = _by_rule(dirty)
+    # OP001 names the unregistered kernel module, anchored there
+    (op1,) = by["OP001"]
+    assert "rogue_kernel" in op1.message
+    assert op1.file == "tpuframe/ops/rogue_kernel.py"
+    # OP002/OP003 anchor at the stale registry row in ledger.py
+    (op2,) = by["OP002"]
+    assert "test_listed.py" in op2.message
+    assert op2.file == "tpuframe/ops/ledger.py"
+    (op3,) = by["OP003"]
+    assert "fused_listed" in op3.message
+    assert op3.file == "tpuframe/ops/ledger.py"
+
+
 def test_hotpath_negatives_stay_quiet():
     """The clean fixture exercises the idioms the rules must NOT flag:
     spanned syncs, static-attribute branching, state donation."""
     result = run_lint(CLEAN)
     assert not [f for f in result.findings if f.rule.startswith("HP")]
+
+
+def test_hazard_graph_stops_at_stdlib_only_modules(tmp_path):
+    """Regression: the syntactic call graph must not propagate
+    traced-rootedness THROUGH stdlib-only modules.  A traced step that
+    consults host-side config at trace time (env knobs, the kernel
+    ledger) reaches stdlib-only code by name; that code contractually
+    cannot hold tracers, so its own callees must not inherit hazard
+    taint — without the boundary, every branch-on-value in pure host
+    helpers lights up as HP002."""
+    pkg = _clean_copy(tmp_path)
+    (pkg / "hostcfg.py").write_text(
+        '"""Host-side config consulted at trace time."""\n'
+        "# tpuframe-lint: stdlib-only\n"
+        "import os\n\n\n"
+        "def _clampf(v):\n"
+        "    scaled = v * 2.0  # derived value: the taint pass tracks it\n"
+        "    if scaled > 3.0:  # host float branch: fine, it's host code\n"
+        "        return 1.5\n"
+        "    return v\n\n\n"
+        "def gate_scale():\n"
+        "    return _clampf(float(os.environ.get('APP_SCALE', '1')))\n"
+    )
+    step = pkg / "train" / "step.py"
+    step.write_text(
+        step.read_text().replace(
+            "        loss = jnp.mean(x)\n",
+            "        from tpuframe.hostcfg import gate_scale\n"
+            "        loss = jnp.mean(x) * gate_scale()\n",
+        )
+    )
+    result = run_lint(str(pkg), str(tmp_path))
+    assert not [f for f in result.findings if f.rule.startswith("HP")], \
+        "\n".join(f.format() for f in result.findings)
+
+    # differential proof the boundary is load-bearing: drop the
+    # stdlib-only contract and the same helper IS flagged
+    cfg = pkg / "hostcfg.py"
+    cfg.write_text(cfg.read_text().replace(
+        "# tpuframe-lint: stdlib-only\n", ""))
+    result = run_lint(str(pkg), str(tmp_path))
+    assert any(
+        f.rule == "HP002" and f.file.endswith("hostcfg.py")
+        for f in result.findings
+    ), "\n".join(f.format() for f in result.findings)
 
 
 def _clean_copy(tmp_path):

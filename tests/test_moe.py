@@ -112,6 +112,87 @@ class TestMoEMLP:
         assert losses[-1] < losses[0]
 
 
+class TestMoEGatingKernel:
+    """The fused scatter/gather dispatch vs the dense-einsum oracle
+    (``tpuframe.ops.moe_gating`` — the OPS_REGISTRY parity pin)."""
+
+    def _case(self, n=64, d=8, e=4, k=2, h=16, seed=0, capacity=None):
+        rng = np.random.default_rng(seed)
+        tokens = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+        logits = jnp.asarray(rng.standard_normal((n, e)).astype(np.float32))
+        gate_vals, gate_idx = jax.lax.top_k(jax.nn.softmax(logits), k)
+        gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+        w_in = jnp.asarray(rng.standard_normal((e, d, h)).astype(np.float32) * 0.1)
+        w_out = jnp.asarray(rng.standard_normal((e, h, d)).astype(np.float32) * 0.1)
+        if capacity is None:
+            capacity = max(1, (k * n) // e)
+        return tokens, gate_vals, gate_idx, w_in, w_out, capacity
+
+    def test_fused_matches_reference(self):
+        from tpuframe.ops.moe_gating import (
+            moe_dispatch_combine, moe_dispatch_combine_reference,
+        )
+
+        for seed in range(3):
+            args = self._case(seed=seed)
+            *inputs, capacity = args
+            want = moe_dispatch_combine_reference(*inputs, capacity=capacity)
+            got = moe_dispatch_combine(*inputs, capacity=capacity, fused=True)
+            # bit-close, not bit-identical: the scatter accumulates in a
+            # different order than the einsum reduction (atol pinned by
+            # the module docstring + bench_kernels_cpu.json)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-5
+            )
+
+    def test_fused_matches_reference_tight_capacity(self):
+        from tpuframe.ops.moe_gating import (
+            moe_dispatch_combine, moe_dispatch_combine_reference,
+        )
+
+        # capacity 1: most slots overflow — drop semantics must agree
+        *inputs, _ = self._case(n=32, e=2, k=2, seed=7)
+        want = moe_dispatch_combine_reference(*inputs, capacity=1)
+        got = moe_dispatch_combine(*inputs, capacity=1, fused=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_fused_grads_match_reference(self):
+        from tpuframe.ops.moe_gating import (
+            moe_dispatch_combine, moe_dispatch_combine_reference,
+        )
+
+        tokens, gate_vals, gate_idx, w_in, w_out, capacity = self._case(n=32)
+
+        def loss(fn, t, wi, wo):
+            return jnp.sum(fn(t, gate_vals, gate_idx, wi, wo,
+                              capacity=capacity) ** 2)
+
+        g_ref = jax.grad(lambda *a: loss(moe_dispatch_combine_reference, *a),
+                         argnums=(0, 1, 2))(tokens, w_in, w_out)
+        fused = lambda *a, **kw: moe_dispatch_combine(*a, fused=True, **kw)  # noqa: E731
+        g_fus = jax.grad(lambda *a: loss(fused, *a),
+                         argnums=(0, 1, 2))(tokens, w_in, w_out)
+        for a, b in zip(g_ref, g_fus):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_kernels_off_forces_reference_path(self, monkeypatch):
+        from tpuframe.ops import dispatch
+        from tpuframe.ops.moe_gating import moe_dispatch_combine
+
+        *inputs, capacity = self._case(n=16)
+        monkeypatch.setenv("TPUFRAME_KERNELS", "off")
+        dispatch._reset_kernel_cache()
+        try:
+            off = moe_dispatch_combine(*inputs, capacity=capacity)
+            monkeypatch.setenv("TPUFRAME_KERNELS", "on")
+            dispatch._reset_kernel_cache()
+            on = moe_dispatch_combine(*inputs, capacity=capacity)
+        finally:
+            dispatch._reset_kernel_cache()
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off), atol=1e-5)
+
+
 def test_aux_loss_reaches_training_objective():
     # the framework train step must fold the sown balance loss into the
     # gradient: router grads differ between aux weight 0 and a large one

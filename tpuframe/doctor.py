@@ -624,6 +624,87 @@ def autotune_section(devices: dict | None = None) -> dict:
     return out
 
 
+def kernels_section(devices: dict | None = None) -> dict:
+    """State of the kernel dispatch plane (``tpuframe.ops``): which
+    Pallas execution mode the env + probed backend would pick, the
+    ``TPUFRAME_KERNELS`` dispatch mode, the live tile-knob values (as
+    the domain-clamped reads the kernels will actually use), every
+    registered dispatchable op, and this host's persisted A/B verdicts
+    with their shape classes — so a "kernels feel off" report says up
+    front what would dispatch and whose measurement decided it.
+    Stdlib-only reads (the ledger module never imports jax); the Pallas
+    mode is recomputed from env + the subprocess probe's backend rather
+    than calling ``ops.dispatch.pallas_mode()``, which needs jax."""
+    from tpuframe.ops.ledger import (
+        KERNEL_ENV_VARS,
+        OPS_REGISTRY,
+        attn_block,
+        ce_rows,
+        kernels_mode,
+        ledger_dir,
+        list_ledgers,
+        norm_tile_rows,
+    )
+    from tpuframe.autotune.config import default_host
+
+    falsy = {"", "0", "false", "no", "off"}
+    disabled = os.environ.get(
+        "TPUFRAME_DISABLE_PALLAS", "").strip().lower() not in falsy
+    interpret = os.environ.get(
+        "TPUFRAME_PALLAS_INTERPRET", "").strip().lower() not in falsy
+    backend = (devices or {}).get("backend")
+    if disabled:
+        pallas = None
+    elif interpret:
+        pallas = "interpret"
+    elif backend is None:
+        pallas = "unprobed"  # backend probe failed; can't tell
+    else:
+        pallas = "compiled" if backend == "tpu" else None
+
+    host = default_host()
+    ledgers = []
+    for led in list_ledgers():
+        if led.host != host:
+            continue
+        ops = {}
+        for op, classes in sorted(led.verdicts.items()):
+            ops[op] = {
+                cls: {
+                    k: v for k, v in verdict.items()
+                    if k in ("enable", "choice", "env", "ratio")
+                }
+                for cls, verdict in sorted(classes.items())
+            }
+        ledgers.append({
+            "backend": led.backend,
+            "signature": led.signature,
+            "matches_probed_backend": (
+                None if backend is None else led.backend == backend
+            ),
+            "verdicts": ops,
+        })
+    return {
+        "mode": kernels_mode(),
+        "pallas": pallas,
+        "registry": sorted(OPS_REGISTRY),
+        "tiles": {
+            "TPUFRAME_KERNEL_CE_ROWS": ce_rows(),
+            "TPUFRAME_KERNEL_NORM_TILE_ROWS": norm_tile_rows(),
+            "TPUFRAME_KERNEL_ATTN_BLOCK": attn_block(),
+        },
+        "env": {
+            k: os.environ[k] for k in KERNEL_ENV_VARS if k in os.environ
+        },
+        "store": ledger_dir(),
+        "ledgers": ledgers,
+        # the paste-ready pair: how to (re)price this host's kernels and
+        # how to price the attention round
+        "price": "python benchmarks/bench_kernels.py --json",
+        "attention": "python benchmarks/bench_attention.py --json",
+    }
+
+
 def lint_section() -> dict:
     """State of the invariant linter (``tpuframe.lint``): the full pass
     run in-process over the installed tree — finding count per rule and
@@ -703,6 +784,7 @@ def report(probe_timeout_s: float = 30.0, ckpt_dir: str | None = None,
         "profile": profile_section(),
         "memory": memory_section(),
         "autotune": autotune_section(devices),
+        "kernels": kernels_section(devices),
         "lint": lint_section(),
         "env": {
             k: os.environ[k]

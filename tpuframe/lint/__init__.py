@@ -40,6 +40,10 @@ Rule families (catalog with fix hints in LINT.md):
 - **CS** (``lint.sites``) — chaos-site registry: every fired injection
   site is declared in ``fault.chaos.CHAOS_SITES`` and documented in
   FAULT.md, and every declared site is actually instrumented.
+- **OP** (``lint.ops_registry``) — kernel dispatch registry: every
+  ``ops/`` kernel module is declared in ``ops.ledger.OPS_REGISTRY``
+  with a resolvable entry point and an existing parity test, so a
+  kernel can't ship undispatched or untested.
 
 Suppression: inline ``# tpuframe-lint: disable=RULE`` on the finding's
 line, or a ``--suppressions`` file (``RULE:file-glob[:substr]`` per

@@ -5,9 +5,9 @@ One parse per file: :func:`load_repo` walks a package tree, parses every
 ``tokenize`` (real comments only — the same text inside a docstring is
 prose, not policy), and loads the schema docs from the repo root.  The
 rule families (``lint.imports`` / ``knobs`` / ``schema`` / ``hazards`` /
-``sites``) are pure functions over that model, so the whole pass costs
-one tree walk + five AST passes — cheap enough for tier-1 and the
-doctor (``benchmarks/bench_lint.py`` prices it).
+``sites`` / ``ops_registry``) are pure functions over that model, so
+the whole pass costs one tree walk + six AST passes — cheap enough for
+tier-1 and the doctor (``benchmarks/bench_lint.py`` prices it).
 """
 
 # tpuframe-lint: stdlib-only
@@ -199,10 +199,12 @@ def run_lint(
     suppressions: Suppressions | str | None = None,
 ) -> LintResult:
     """The full pass: load, run every rule family, apply suppressions."""
-    from tpuframe.lint import hazards, imports, knobs, schema, sites
+    from tpuframe.lint import (
+        hazards, imports, knobs, ops_registry, schema, sites,
+    )
 
     repo = load_repo(package_dir, docs_dir)
-    families = (imports, knobs, schema, sites, hazards)
+    families = (imports, knobs, schema, sites, hazards, ops_registry)
     findings: list[Finding] = []
     rules_run = 0
     for family in families:

@@ -27,6 +27,10 @@ names skipped) and the traced-value analysis is a conservative taint
 pass — both err toward silence on idiomatic code; a finding here is
 worth reading, and ``# tpuframe-lint: disable=HP00x`` with a
 justification is the waiver channel when the sync is deliberate.
+Expansion stops at ``stdlib-only`` modules: code that contractually
+cannot import jax or numpy holds no device arrays and no tracers, so
+the graph doesn't contaminate through a trace-time config/telemetry
+read into unrelated host code.
 """
 
 # tpuframe-lint: stdlib-only
@@ -111,8 +115,15 @@ def _seed_functions(repo: Repo, by_name) -> list[FuncInfo]:
     return seeds
 
 
-def _reachable(seeds, by_name) -> set[int]:
-    """ids of FuncInfos reachable from the seeds over the name graph."""
+def _reachable(seeds, by_name, stop_modules=frozenset()) -> set[int]:
+    """ids of FuncInfos reachable from the seeds over the name graph.
+
+    ``stop_modules`` (the stdlib-only set) is a contamination boundary:
+    a module that contractually cannot import jax or numpy holds no
+    device arrays and no tracers, so neither hazard class can propagate
+    through it — expanding past it only manufactures false positives
+    (e.g. a trace-time ledger read name-resolving into every
+    ``from_dict`` in the tree)."""
     seen: set[int] = set()
     work = list(seeds)
     while work:
@@ -120,6 +131,8 @@ def _reachable(seeds, by_name) -> set[int]:
         if id(info) in seen:
             continue
         seen.add(id(info))
+        if info.module in stop_modules:
+            continue  # host-only code: don't expand through it
         for name in info.calls:
             if name in _AMBIGUOUS or name.startswith("__"):
                 continue
@@ -423,9 +436,12 @@ def check(repo: Repo) -> list[Finding]:
     seeds = _seed_functions(repo, by_name)
     if not seeds:
         return _check_donation(repo, by_name)
-    reachable_ids = _reachable(seeds, by_name)
+    host_only = frozenset(
+        m for m, src in repo.files.items() if src.stdlib_only
+    )
+    reachable_ids = _reachable(seeds, by_name, host_only)
     traced_roots = _traced_roots(repo, by_name)
-    traced_ids = _reachable(traced_roots, by_name)
+    traced_ids = _reachable(traced_roots, by_name, host_only)
 
     findings: list[Finding] = []
     all_infos = [i for infos in by_name.values() for i in infos]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.core.runtime import EXPERT_AXIS
+from tpuframe.ops.moe_gating import moe_dispatch_combine
 
 
 def moe_rules():
@@ -80,31 +81,12 @@ class MoEMLP(nn.Module):
             jnp.sum(gate_vals, -1, keepdims=True), 1e-9
         )
 
-        # position of each (token, choice) inside its expert's buffer:
-        # count prior assignments to the same expert in flattened
-        # (choice-major) order, so choice 0 fills before choice 1
-        choice_exp = gate_idx.T.reshape(-1)  # (k*N,) choice-major
-        onehot = jax.nn.one_hot(choice_exp, e, dtype=jnp.int32)  # (kN, E)
-        pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot - onehot
-        pos = jnp.sum(pos_in_expert, axis=-1)  # (kN,)
-        keep = pos < capacity
-
-        # dispatch/combine in the flattened (kN,) frame
-        tok_idx = jnp.tile(jnp.arange(n), k)  # token of each flat slot
-        disp = (
-            jax.nn.one_hot(choice_exp, e, dtype=x.dtype)[:, :, None]
-            * jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
-                             dtype=x.dtype)[:, None, :]
-            * keep[:, None, None]
-        )  # (kN, E, C)
-        gates_flat = gate_vals.T.reshape(-1)  # choice-major to match
-
-        # expert inputs: (E, C, D)
-        expert_in = jnp.einsum(
-            "fec,fd->ecd", disp, tokens[tok_idx].astype(self.dtype)
-        )
-
-        # --- expert computation (E stacked MLPs, shardable over expert) --
+        # --- dispatch / expert MLPs / combine ----------------------------
+        # tpuframe.ops.moe_gating owns the mechanics: the fused path
+        # scatter-adds kept tokens straight into the (E, C, D) expert
+        # buffers (no (kN, E, C) one-hot tensor), the dense-einsum
+        # reference is the oracle, and the kernel ledger decides which
+        # runs (TPUFRAME_KERNELS / a priced per-shape verdict).
         h = d * self.mlp_ratio
         w_in = self.param(
             "w_in", nn.initializers.lecun_normal(), (e, d, h), self.dtype
@@ -112,17 +94,10 @@ class MoEMLP(nn.Module):
         w_out = self.param(
             "w_out", nn.initializers.lecun_normal(), (e, h, d), self.dtype
         )
-        expert_out = jnp.einsum(
-            "ecd,edh->ech", expert_in, w_in
+        out = moe_dispatch_combine(
+            tokens, gate_vals, gate_idx, w_in, w_out,
+            capacity=capacity, act=nn.gelu,
         )
-        expert_out = nn.gelu(expert_out)
-        expert_out = jnp.einsum("ech,ehd->ecd", expert_out, w_out)
-
-        # --- combine -----------------------------------------------------
-        combine = disp * gates_flat[:, None, None]  # (kN, E, C)
-        out_flat = jnp.einsum("fec,ecd->fd", combine, expert_out)
-        # sum the k choices back per token
-        out = jnp.zeros((n, d), out_flat.dtype).at[tok_idx].add(out_flat)
 
         # --- load-balance aux loss (Switch eq. 4) ------------------------
         # fraction of tokens routed to each expert (by top-1 choice) x

@@ -103,12 +103,21 @@ class SelfAttention(nn.Module):
             seq_sharded = mesh is not None and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
             if seq_sharded:
                 impl = "ring"
-            elif l >= _BLOCKWISE_AUTO_LEN:
-                # long unsharded context: the (B,H,L,L) score matrix is the
-                # memory hazard; take the flash-style linear-memory path
-                impl = "blockwise"
             else:
-                impl = "full"
+                # measured first: the kernel ledger's priced verdict for
+                # this seq-length shape class (bench_attention persists
+                # them); the static memory-hazard heuristic is only the
+                # fallback when nothing has been measured here
+                from tpuframe.ops.ledger import attention_choice
+
+                impl = attention_choice(l)
+                if impl is None:
+                    # long unsharded context: the (B,H,L,L) score matrix
+                    # is the memory hazard; take the flash-style
+                    # linear-memory path
+                    impl = (
+                        "blockwise" if l >= _BLOCKWISE_AUTO_LEN else "full"
+                    )
         if impl in ("ring", "ulysses"):
             if mesh is None:
                 raise ValueError(

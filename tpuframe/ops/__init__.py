@@ -35,6 +35,10 @@ import types as _types
 
 _LAZY = {
     "use_pallas": "tpuframe.ops.dispatch",
+    "kernel_enabled": "tpuframe.ops.dispatch",
+    "kernels_mode": "tpuframe.ops.ledger",
+    "moe_dispatch_combine": "tpuframe.ops.moe_gating",
+    "moe_dispatch_combine_reference": "tpuframe.ops.moe_gating",
     "normalize_images": "tpuframe.ops.normalize",
     "normalize_images_reference": "tpuframe.ops.normalize",
     "fused_cross_entropy": "tpuframe.ops.cross_entropy",

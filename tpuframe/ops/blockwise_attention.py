@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuframe.ops.ledger import attn_block
 from tpuframe.ops.ring_attention import _block_update, _causal_skip, _tile_grads
 
 __all__ = ["blockwise_attention"]
@@ -229,9 +230,16 @@ def blockwise_attention(
     v: jax.Array,
     *,
     causal: bool = False,
-    block_size: int = 512,
+    block_size: int | None = None,
 ) -> jax.Array:
-    """Exact attention over (B, L, H, D) without materializing (.., L, L)."""
+    """Exact attention over (B, L, H, D) without materializing (.., L, L).
+
+    ``block_size`` defaults to the domain-clamped
+    ``TPUFRAME_KERNEL_ATTN_BLOCK`` knob (512) — the tile the kernel
+    ledger probes over its legal grid; an explicit value always wins.
+    """
+    if block_size is None:
+        block_size = attn_block()
     b, l, h, d = q.shape
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(

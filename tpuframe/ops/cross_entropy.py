@@ -23,9 +23,11 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.ops.dispatch import batch_sharding_info, pad_to, resolve_interpret
+from tpuframe.ops.ledger import ce_rows, shape_class
 from tpuframe.core.runtime import shard_map
 
-_ROWS = 16  # rows per grid step; sublane-aligned for f32/bf16
+# rows per grid step: domain-clamped knob (TPUFRAME_KERNEL_CE_ROWS,
+# default 16, sublane-aligned) the kernel ledger probes per shape class
 _LANES = 128
 
 
@@ -65,40 +67,42 @@ def _bwd_kernel(logits_ref, labels_ref, g_ref, grad_ref, *, n_classes):
     grad_ref[...] = jnp.where(cols < n_classes, grad, 0.0).astype(grad_ref.dtype)
 
 
-def _pad_inputs(logits, labels):
+def _pad_inputs(logits, labels, rows):
     b, k = logits.shape
-    bp, kp = pad_to(b, _ROWS), pad_to(k, _LANES)
+    bp, kp = pad_to(b, rows), pad_to(k, _LANES)
     logits = jnp.pad(logits, ((0, bp - b), (0, kp - k)))
     labels = jnp.pad(labels.astype(jnp.int32), (0, bp - b))[:, None]
     return logits, labels, b, k, bp, kp
 
 
-def _row_spec(width):
-    return pl.BlockSpec((_ROWS, width), lambda i: (i, 0))
+def _row_spec(rows, width):
+    return pl.BlockSpec((rows, width), lambda i: (i, 0))
 
 
 def _fwd_pallas(logits, labels, interpret):
-    logits_p, labels_p, b, k, bp, kp = _pad_inputs(logits, labels)
+    rows = ce_rows()
+    logits_p, labels_p, b, k, bp, kp = _pad_inputs(logits, labels, rows)
     loss = pl.pallas_call(
         functools.partial(_fwd_kernel, n_classes=k),
         out_shape=jax.ShapeDtypeStruct((bp, 1), jnp.float32),
-        grid=(bp // _ROWS,),
-        in_specs=[_row_spec(kp), _row_spec(1)],
-        out_specs=_row_spec(1),
+        grid=(bp // rows,),
+        in_specs=[_row_spec(rows, kp), _row_spec(rows, 1)],
+        out_specs=_row_spec(rows, 1),
         interpret=interpret,
     )(logits_p, labels_p)
     return loss[:b, 0]
 
 
 def _bwd_pallas(logits, labels, g, interpret):
-    logits_p, labels_p, b, k, bp, kp = _pad_inputs(logits, labels)
+    rows = ce_rows()
+    logits_p, labels_p, b, k, bp, kp = _pad_inputs(logits, labels, rows)
     g_p = jnp.pad(g.astype(jnp.float32), (0, bp - b))[:, None]
     grad = pl.pallas_call(
         functools.partial(_bwd_kernel, n_classes=k),
         out_shape=jax.ShapeDtypeStruct((bp, kp), logits.dtype),
-        grid=(bp // _ROWS,),
-        in_specs=[_row_spec(kp), _row_spec(1), _row_spec(1)],
-        out_specs=_row_spec(kp),
+        grid=(bp // rows,),
+        in_specs=[_row_spec(rows, kp), _row_spec(rows, 1), _row_spec(rows, 1)],
+        out_specs=_row_spec(rows, kp),
         interpret=interpret,
     )(logits_p, labels_p, g_p)
     return grad[:b, :k]
@@ -145,7 +149,10 @@ def fused_cross_entropy(
     axes, n_shards, shardable = batch_sharding_info(
         mesh, batch_axes, logits.shape[0]
     )
-    interpret = resolve_interpret(interpret, shardable)
+    interpret = resolve_interpret(
+        interpret, shardable, op="cross_entropy",
+        shape_class=shape_class(b=logits.shape[0], k=logits.shape[1]),
+    )
     if interpret is None:
         return cross_entropy_reference(logits, labels)
     if shardable and n_shards > 1:

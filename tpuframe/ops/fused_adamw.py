@@ -128,7 +128,11 @@ def fused_adamw_update(
     )
     shardable = axis_size > 1 and rows % axis_size == 0
 
-    interpret = resolve_interpret(interpret, shardable)
+    from tpuframe.ops.ledger import shape_class
+
+    interpret = resolve_interpret(
+        interpret, shardable, op="fused_adamw", shape_class=shape_class(n=n)
+    )
     if interpret is None:
         t = step.astype(jnp.float32)
         p_new, m_new, v_new = _update_math(

@@ -225,7 +225,12 @@ def fused_layer_norm(
             mesh, batch_axes, lead[0] if lead else 0
         )
         spec = P(axes, *([None] * (x.ndim - 1)))
-    interpret = resolve_interpret(interpret, shardable)
+    from tpuframe.ops.ledger import shape_class
+
+    interpret = resolve_interpret(
+        interpret, shardable, op="layer_norm",
+        shape_class=shape_class(d=x.shape[-1]),
+    )
     if interpret is None:
         return layer_norm_reference(x, scale, bias, eps)
 

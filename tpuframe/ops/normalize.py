@@ -22,10 +22,13 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 from tpuframe.ops.dispatch import batch_sharding_info, resolve_interpret
+from tpuframe.ops.ledger import norm_tile_rows, shape_class
 from tpuframe.core.runtime import shard_map
 
 _LANES = 128
-_TILE_ROWS = 256  # 256x128 f32 tile = 128 KiB of VMEM
+# row-tile height: domain-clamped knob (TPUFRAME_KERNEL_NORM_TILE_ROWS,
+# default 256 -> a 256x128 f32 tile = 128 KiB of VMEM) the kernel
+# ledger probes per shape class
 
 
 def normalize_images_reference(
@@ -75,7 +78,7 @@ def _pallas_normalize(flat, weights, biases, n_channels, out_dtype, interpret):
         rows = -(-n // _LANES)
         flat = jnp.pad(flat, (0, rows * _LANES - n))
     padded = rows * _LANES
-    tile = min(_TILE_ROWS, rows)
+    tile = min(norm_tile_rows(), rows)
     kernel = functools.partial(
         _kernel,
         weights=weights,
@@ -126,7 +129,10 @@ def normalize_images(
     axes, n_shards, shardable = batch_sharding_info(
         mesh, batch_axes, images.shape[0] if images.ndim >= 2 else 0
     )
-    interpret = resolve_interpret(interpret, shardable)
+    interpret = resolve_interpret(
+        interpret, shardable, op="normalize",
+        shape_class=shape_class(n=images.size),
+    )
     if interpret is None:
         return normalize_images_reference(images, mean, std, scale, out_dtype)
     weights = tuple(scale / s for s in std)

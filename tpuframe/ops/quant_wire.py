@@ -230,7 +230,7 @@ def _bucketed(v, amax=None) -> bool:
 def bucket_abs_max(v, interpret: bool | None = None):
     """Per-bucket abs-max of a (buckets, elems) array, keepdims — the
     scale-agreement input for the compressed wire."""
-    interp = resolve_interpret(interpret, shardable=False)
+    interp = resolve_interpret(interpret, shardable=False, op="quant_wire")
     if interp is None or not _bucketed(v):
         return bucket_abs_max_reference(v)
     return _pallas_bucket_abs_max(v.astype(jnp.float32), bool(interp))
@@ -243,7 +243,7 @@ def quant_encode(v, amax, mode: str, noise=None,
     pass when the kernel engages.  ``noise`` (same shape as ``v``)
     selects unbiased stochastic rounding on the int8 grid; fp8 ignores
     it (RTNE in the dtype cast)."""
-    interp = resolve_interpret(interpret, shardable=False)
+    interp = resolve_interpret(interpret, shardable=False, op="quant_wire")
     if interp is None or not _bucketed(v, amax):
         return quant_encode_reference(v, amax, mode, noise)
     denom = jnp.maximum(amax, _tiny())
@@ -260,7 +260,7 @@ def quant_decode(total, amax, mode: str, world: int,
     """Decode summed payloads to the mean gradient (dequant + divide +
     non-finite propagation fused), matching
     :func:`quant_decode_reference` bit-for-bit."""
-    interp = resolve_interpret(interpret, shardable=False)
+    interp = resolve_interpret(interpret, shardable=False, op="quant_wire")
     if interp is None or not _bucketed(total, amax):
         return quant_decode_reference(total, amax, mode, world)
     return _pallas_decode(total, amax, mode, int(world), bool(interp))
